@@ -1,0 +1,32 @@
+"""skypilot_tpu — a TPU-native AI-infrastructure orchestrator.
+
+A brand-new framework with the capabilities of SkyPilot (run, manage and scale
+AI workloads on cloud infrastructure), designed idiomatically for GCP TPU pod
+slices and JAX/XLA workloads: Task/Resources YAML front-end, cost+availability
+optimizer over a TPU-first catalog, queued-resource provisioner with stockout
+failover, a head-host agent with a gang executor that plumbs
+`jax.distributed.initialize` across slice hosts (no Ray), managed jobs with
+preemption auto-recovery, and an autoscaling serving layer — plus a JAX
+compute library (`models/`, `ops/`, `parallel/`) providing the sharded
+training/serving recipes the reference ships as torch/NCCL examples.
+"""
+
+__version__ = '0.1.0'
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.accelerators import TpuType, is_tpu, parse_tpu
+from skypilot_tpu.dag import Dag
+from skypilot_tpu.resources import AutostopConfig, Resources
+from skypilot_tpu.task import Task
+
+__all__ = [
+    'AutostopConfig',
+    'Dag',
+    'Resources',
+    'Task',
+    'TpuType',
+    'exceptions',
+    'is_tpu',
+    'parse_tpu',
+    '__version__',
+]

@@ -1,0 +1,277 @@
+"""Accelerator registry — TPUs are the primary citizen.
+
+The reference keeps a GPU-centric registry where names prefixed ``tpu-`` bypass
+the canonical GPU list (sky/utils/accelerator_registry.py:13-30) and TPU host
+topology is hardcoded in the GCP cloud (sky/clouds/gcp.py:717-768,
+cloud_vm_ray_backend.py:2485 `num_ips_per_node`).  Here the registry is built
+the other way around: every TPU generation carries its full hardware model —
+chips, hosts, ICI topology, HBM, peak FLOP/s — because the optimizer, the
+provisioner (slice shapes), the gang executor (hosts per slice) and the mesh
+builder (ICI axes) all consume it.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+import re
+from typing import Dict, List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+
+
+@dataclasses.dataclass(frozen=True)
+class TpuGeneration:
+    """Static hardware model of one TPU generation."""
+    name: str                     # canonical, e.g. 'v5p'
+    aliases: Tuple[str, ...]      # accepted in accelerator strings
+    cores_per_chip: int           # suffix in GCP type names counts cores for
+                                  # v2..v5p, chips for v5e/v6e
+    suffix_counts_chips: bool     # True for v5litepod / v6e
+    chips_per_host: int           # chips on one host VM (full host)
+    small_slice_host_chips: int   # chips/host for single-host slices
+    hbm_gb_per_chip: float
+    bf16_tflops_per_chip: float   # peak dense bf16
+    int8_tops_per_chip: float
+    ici_dims: int                 # 2 = 2D torus (v2/v3/v5e/v6e), 3 = 3D (v4/v5p)
+    default_runtime_version: str
+    host_vcpus: int
+    host_memory_gb: float
+    min_chips: int = 1
+    max_chips: int = 8192
+
+
+# Peak numbers from public Cloud TPU system architecture docs.  max_chips
+# reflects the largest pod slice GCP allocates per generation.
+GENERATIONS: Dict[str, TpuGeneration] = {
+    'v2': TpuGeneration('v2', ('v2',), 2, False, 4, 4, 16, 45, 0, 2,
+                        'tpu-vm-base', 96, 334, min_chips=4, max_chips=256),
+    'v3': TpuGeneration('v3', ('v3',), 2, False, 4, 4, 32, 123, 0, 2,
+                        'tpu-vm-base', 96, 334, min_chips=4, max_chips=1024),
+    'v4': TpuGeneration('v4', ('v4',), 2, False, 4, 4, 32, 275, 275, 3,
+                        'tpu-vm-v4-base', 240, 400, min_chips=4,
+                        max_chips=4096),
+    'v5litepod': TpuGeneration('v5litepod', ('v5litepod', 'v5e', 'v5lite'), 1,
+                               True, 8, 8, 16, 197, 394, 2,
+                               'v2-alpha-tpuv5-lite', 224, 400, min_chips=1,
+                               max_chips=256),
+    'v5p': TpuGeneration('v5p', ('v5p',), 2, False, 4, 4, 95, 459, 918, 3,
+                         'v2-alpha-tpuv5', 208, 448, min_chips=4,
+                         max_chips=6144),
+    'v6e': TpuGeneration('v6e', ('v6e', 'trillium'), 1, True, 8, 8, 32, 918,
+                         1836, 2, 'v2-alpha-tpuv6e', 180, 720, min_chips=1,
+                         max_chips=256),
+}
+
+_ALIAS_TO_GEN: Dict[str, str] = {}
+for _g in GENERATIONS.values():
+    for _a in _g.aliases:
+        _ALIAS_TO_GEN[_a] = _g.name
+
+_TPU_RE = re.compile(
+    r'^tpu[-_]?(?P<gen>v[0-9]+[a-z]*(?:pod|lite)?|trillium)'
+    r'(?:[-:](?P<count>\d+))?$', re.IGNORECASE)
+
+
+@dataclasses.dataclass(frozen=True)
+class TpuType:
+    """A parsed, concrete TPU slice request, e.g. ``tpu-v5p-128``."""
+    generation: str          # canonical generation name
+    count_suffix: int        # the number in the accelerator string
+    topology: Optional[str] = None   # e.g. '4x4x8'; None = provider default
+
+    @property
+    def gen(self) -> TpuGeneration:
+        return GENERATIONS[self.generation]
+
+    @property
+    def num_chips(self) -> int:
+        g = self.gen
+        if g.suffix_counts_chips:
+            return self.count_suffix
+        return self.count_suffix // g.cores_per_chip
+
+    @property
+    def num_cores(self) -> int:
+        g = self.gen
+        if g.suffix_counts_chips:
+            return self.count_suffix * g.cores_per_chip
+        return self.count_suffix
+
+    @property
+    def num_hosts(self) -> int:
+        """Host VMs in the slice.
+
+        Matches GCP slice shapes: single-host up to a full host's chips;
+        multi-host slices use 4-chip hosts for every generation (v5e-16 and
+        v6e-16 are 4 hosts x 4 chips; reference observed the same fan-out via
+        `num_ips_per_node`, cloud_vm_ray_backend.py:2485,:5940).
+        """
+        g = self.gen
+        chips = self.num_chips
+        if chips <= g.small_slice_host_chips:
+            return 1
+        return max(1, math.ceil(chips / 4))
+
+    @property
+    def chips_per_host(self) -> int:
+        return self.num_chips // self.num_hosts
+
+    @property
+    def is_pod(self) -> bool:
+        """Multi-host slice.  Pods cannot be stopped, only deleted
+        (reference: sky/clouds/gcp.py:219-226)."""
+        return self.num_hosts > 1
+
+    @property
+    def name(self) -> str:
+        """Canonical accelerator string, e.g. ``tpu-v5p-128``."""
+        return f'tpu-{self.generation}-{self.count_suffix}'
+
+    @property
+    def gcp_accelerator_type(self) -> str:
+        """The TPU API acceleratorType, e.g. ``v5p-128`` (no ``tpu-``)."""
+        return f'{self.generation}-{self.count_suffix}'
+
+    @property
+    def runtime_version(self) -> str:
+        return self.gen.default_runtime_version
+
+    @property
+    def hbm_gb(self) -> float:
+        return self.num_chips * self.gen.hbm_gb_per_chip
+
+    @property
+    def bf16_tflops(self) -> float:
+        return self.num_chips * self.gen.bf16_tflops_per_chip
+
+    def default_topology(self) -> Tuple[int, ...]:
+        """ICI mesh shape for the slice (used to build `jax.sharding.Mesh`).
+
+        3D generations (v4/v5p) get an x,y,z torus; 2D generations a x,y
+        grid.  Chosen as the most-square factorization, which is what the
+        TPU API allocates by default.
+        """
+        chips = self.num_chips
+        dims = self.gen.ici_dims
+        if dims == 2:
+            x = 1
+            for f in range(int(math.isqrt(chips)), 0, -1):
+                if chips % f == 0:
+                    x = f
+                    break
+            return (x, chips // x)
+        # 3D: factor into near-cube
+        best = (1, 1, chips)
+        best_score = float('inf')
+        for a in range(1, int(round(chips ** (1 / 3))) + 2):
+            if chips % a:
+                continue
+            rest = chips // a
+            for b in range(a, int(math.isqrt(rest)) + 1):
+                if rest % b:
+                    continue
+                c = rest // b
+                score = (c - a) + (c - b)
+                if score < best_score:
+                    best_score = score
+                    best = (a, b, c)
+        return best
+
+    def __str__(self) -> str:
+        return self.name
+
+
+def is_tpu(accelerator: Optional[str]) -> bool:
+    """True iff the accelerator string names a TPU (analog of
+    gcp_utils.is_tpu, sky/clouds/utils/gcp_utils.py:30-50)."""
+    return accelerator is not None and bool(_TPU_RE.match(accelerator.strip()))
+
+
+@functools.lru_cache(maxsize=4096)
+def parse_tpu(accelerator: str) -> TpuType:
+    """Parse ``tpu-v5p-128`` / ``tpu-v6e:8`` / ``tpu-v5e-16`` into a TpuType."""
+    m = _TPU_RE.match(accelerator.strip())
+    if not m:
+        raise exceptions.InvalidAcceleratorError(
+            f'Not a TPU accelerator string: {accelerator!r}. Expected e.g. '
+            f"'tpu-v5p-8', 'tpu-v6e-16'.")
+    gen_alias = m.group('gen').lower()
+    gen = _ALIAS_TO_GEN.get(gen_alias)
+    if gen is None:
+        raise exceptions.InvalidAcceleratorError(
+            f'Unknown TPU generation {gen_alias!r} in {accelerator!r}. Known: '
+            f'{sorted(_ALIAS_TO_GEN)}')
+    g = GENERATIONS[gen]
+    count = int(m.group('count') or (g.small_slice_host_chips *
+                                     (1 if g.suffix_counts_chips else
+                                      g.cores_per_chip)))
+    if not g.suffix_counts_chips and count % g.cores_per_chip:
+        raise exceptions.InvalidAcceleratorError(
+            f'{accelerator!r}: core count {count} must be a multiple of '
+            f'{g.cores_per_chip} for {gen}.')
+    tpu = TpuType(gen, count)
+    chips = tpu.num_chips
+    if chips < g.min_chips or chips > g.max_chips:
+        raise exceptions.InvalidAcceleratorError(
+            f'{accelerator!r}: {chips} chips out of range '
+            f'[{g.min_chips}, {g.max_chips}] for {gen}.')
+    # Multi-host slices always use 4-chip hosts, so the chip count must tile
+    # exactly; otherwise the gang executor would see an inconsistent slice.
+    if chips > g.small_slice_host_chips and chips % 4 != 0:
+        raise exceptions.InvalidAcceleratorError(
+            f'{accelerator!r}: multi-host slices need a multiple of 4 chips, '
+            f'got {chips}.')
+    if chips <= g.small_slice_host_chips and chips not in (1, 2, 4, 8):
+        raise exceptions.InvalidAcceleratorError(
+            f'{accelerator!r}: single-host slice sizes are 1/2/4/8 chips, '
+            f'got {chips}.')
+    return tpu
+
+
+# A small GPU/CPU table so non-TPU controllers and mixed clusters still
+# resolve (the reference keeps these in hosted CSV catalogs).
+@dataclasses.dataclass(frozen=True)
+class GpuSpec:
+    name: str
+    memory_gb: float
+    bf16_tflops: float
+
+
+GPUS: Dict[str, GpuSpec] = {
+    'A100': GpuSpec('A100', 40, 312),
+    'A100-80GB': GpuSpec('A100-80GB', 80, 312),
+    'H100': GpuSpec('H100', 80, 989),
+    'L4': GpuSpec('L4', 24, 121),
+    'T4': GpuSpec('T4', 16, 65),
+    'V100': GpuSpec('V100', 16, 112),
+}
+
+
+def canonicalize(accelerator: str) -> str:
+    """Canonical accelerator name: TPUs normalized through parse_tpu, GPUs
+    upper-cased against the GPU table."""
+    if is_tpu(accelerator):
+        return parse_tpu(accelerator).name
+    upper = accelerator.upper()
+    for name in GPUS:
+        if name.upper() == upper:
+            return name
+    raise exceptions.InvalidAcceleratorError(
+        f'Unknown accelerator {accelerator!r}. TPUs: tpu-<gen>-<size>; '
+        f'GPUs: {sorted(GPUS)}')
+
+
+def list_tpu_types(generation: Optional[str] = None) -> List[str]:
+    """Enumerate valid slice sizes per generation (for `accelerators list`)."""
+    sizes = [1, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048]
+    out = []
+    for g in GENERATIONS.values():
+        if generation and g.name != _ALIAS_TO_GEN.get(generation, generation):
+            continue
+        for s in sizes:
+            try:
+                out.append(parse_tpu(f'tpu-{g.name}-{s}').name)
+            except exceptions.InvalidAcceleratorError:
+                continue
+    return out

@@ -1,0 +1,129 @@
+"""Dag: a DAG of Tasks (capability parity: sky/dag.py:11).
+
+Same shape as the reference: a networkx DiGraph of Task nodes, an ambient
+context manager so `task_a >> task_b` works, chain detection for the
+optimizer's DP path, and multi-document-YAML pipelines.
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+import networkx as nx
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.utils import common_utils
+
+_dag_context = threading.local()
+
+
+class Dag:
+    """Container of Tasks with dependency edges."""
+
+    def __init__(self, name: Optional[str] = None) -> None:
+        self.name = name
+        self.graph = nx.DiGraph()
+        self._task_order: List[task_lib.Task] = []
+
+    # ----- construction ------------------------------------------------------
+    def add(self, task: task_lib.Task) -> None:
+        if task not in self.graph:
+            self.graph.add_node(task)
+            self._task_order.append(task)
+
+    def remove(self, task: task_lib.Task) -> None:
+        self.graph.remove_node(task)
+        self._task_order.remove(task)
+
+    def add_edge(self, op1: task_lib.Task, op2: task_lib.Task) -> None:
+        self.add(op1)
+        self.add(op2)
+        self.graph.add_edge(op1, op2)
+
+    @property
+    def tasks(self) -> List[task_lib.Task]:
+        return list(self._task_order)
+
+    def __len__(self) -> int:
+        return len(self._task_order)
+
+    # ----- queries -----------------------------------------------------------
+    def validate(self) -> None:
+        if not nx.is_directed_acyclic_graph(self.graph):
+            name = self.name or '<unnamed>'
+            raise exceptions.InvalidDagError(f'Dag {name!r} has a cycle.')
+
+    def is_chain(self) -> bool:
+        """Linear pipeline?  Enables the optimizer's DP path
+        (reference: sky/dag.py chain detection; sky/optimizer.py:429)."""
+        if len(self.graph) <= 1:
+            return True
+        degrees = [
+            (self.graph.in_degree(n), self.graph.out_degree(n))
+            for n in self.graph.nodes
+        ]
+        return (nx.is_directed_acyclic_graph(self.graph) and
+                all(i <= 1 and o <= 1 for i, o in degrees) and
+                nx.number_weakly_connected_components(self.graph) == 1)
+
+    def topological_order(self) -> List[task_lib.Task]:
+        self.validate()
+        if len(self.graph) == 0:
+            return []
+        return list(nx.topological_sort(self.graph))
+
+    # ----- context manager ---------------------------------------------------
+    def __enter__(self) -> 'Dag':
+        push_dag(self)
+        return self
+
+    def __exit__(self, *_) -> None:
+        pop_dag()
+
+    def __repr__(self) -> str:
+        return f'Dag({self.name!r}, tasks={len(self)})'
+
+
+def push_dag(dag: Dag) -> None:
+    stack = getattr(_dag_context, 'stack', None)
+    if stack is None:
+        stack = _dag_context.stack = []
+    stack.append(dag)
+
+
+def pop_dag() -> Optional[Dag]:
+    stack = getattr(_dag_context, 'stack', None)
+    return stack.pop() if stack else None
+
+
+def get_current_dag() -> Optional[Dag]:
+    stack = getattr(_dag_context, 'stack', None)
+    return stack[-1] if stack else None
+
+
+def dag_from_task(task: task_lib.Task, name: Optional[str] = None) -> Dag:
+    dag = Dag(name or task.name)
+    dag.add(task)
+    return dag
+
+
+def load_chain_dag_from_yaml(path: str) -> Dag:
+    """Multi-document YAML → linear pipeline.  First doc may be a header with
+    only `name:` (reference CLI pipeline format)."""
+    configs = common_utils.read_yaml_all(path)
+    dag_name = None
+    if configs and set(configs[0].keys()) <= {'name'}:
+        dag_name = configs[0].get('name')
+        configs = configs[1:]
+    if not configs:
+        raise exceptions.InvalidTaskError(f'No tasks found in {path}')
+    dag = Dag(dag_name)
+    prev = None
+    for config in configs:
+        t = task_lib.Task.from_yaml_config(config)
+        dag.add(t)
+        if prev is not None:
+            dag.add_edge(prev, t)
+        prev = t
+    return dag

@@ -1,0 +1,348 @@
+"""Immutable resource requests (capability parity: sky/resources.py).
+
+The reference models accelerators as a GPU-shaped ``{name: count}`` dict with
+TPUs wedged in via ``accelerator_args`` (``tpu_vm``, ``runtime_version`` —
+sky/resources.py:837) and a ``TPU-VM`` pseudo instance type
+(sky/clouds/gcp.py:281).  Here a TPU slice is the primary resource shape:
+``accelerators: tpu-v5p-128`` resolves to a `TpuType` carrying chips, hosts
+and ICI topology, and the host VM is implied by the slice (the TPU API
+allocates host VMs with the slice; there is no instance-type choice to make).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Union
+
+from skypilot_tpu import accelerators as acc_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils import infra_utils
+
+_DEFAULT_DISK_SIZE_GB = 256
+
+
+@dataclasses.dataclass(frozen=True)
+class AutostopConfig:
+    """Autostop/autodown (reference: sky/resources.py:62 AutostopConfig)."""
+    enabled: bool = False
+    idle_minutes: int = 5
+    down: bool = False   # TPU pods cannot stop; autostop implies down for pods
+    wait_for_jobs: bool = True
+
+    @classmethod
+    def from_yaml_config(
+            cls, config: Union[None, bool, int, Dict[str, Any]]
+    ) -> Optional['AutostopConfig']:
+        if config is None:
+            return None
+        if isinstance(config, bool):
+            return cls(enabled=config)
+        if isinstance(config, int):
+            return cls(enabled=True, idle_minutes=config)
+        if isinstance(config, dict):
+            return cls(enabled=True,
+                       idle_minutes=int(config.get('idle_minutes', 5)),
+                       down=bool(config.get('down', False)))
+        raise exceptions.InvalidResourcesError(
+            f'Invalid autostop config: {config!r}')
+
+    def to_yaml_config(self) -> Union[bool, Dict[str, Any]]:
+        if not self.enabled:
+            return False
+        return {'idle_minutes': self.idle_minutes, 'down': self.down}
+
+
+def _parse_accelerators(
+    value: Union[None, str, Dict[str, int]]
+) -> Optional[Dict[str, int]]:
+    """Normalize `accelerators:` to {canonical_name: count}.
+
+    TPU slices always have count 1 (the slice IS the unit); 'tpu-v6e:8' is
+    sugar for tpu-v6e-8 (a slice of 8 chips), matching reference behavior
+    where the TPU type encodes size.
+    """
+    if value is None:
+        return None
+    if isinstance(value, str):
+        value = value.strip()
+        if acc_lib.is_tpu(value):
+            return {acc_lib.parse_tpu(value).name: 1}
+        if ':' in value:
+            name, _, cnt = value.partition(':')
+            return {acc_lib.canonicalize(name): int(cnt)}
+        return {acc_lib.canonicalize(value): 1}
+    if isinstance(value, dict):
+        out: Dict[str, int] = {}
+        for name, cnt in value.items():
+            if acc_lib.is_tpu(name):
+                out[acc_lib.parse_tpu(name).name] = 1
+            else:
+                out[acc_lib.canonicalize(name)] = int(cnt)
+        return out
+    raise exceptions.InvalidResourcesError(
+        f'Invalid accelerators spec: {value!r}')
+
+
+@dataclasses.dataclass(frozen=True)
+class Resources:
+    """An immutable resource request.
+
+    Unset (None) fields mean "any"; the optimizer fills them in, producing a
+    *launchable* Resources (cloud+region+accelerator all concrete), the analog
+    of the reference `LaunchableResources` (sky/resources.py:2524).
+    """
+    infra: infra_utils.InfraInfo = dataclasses.field(
+        default_factory=infra_utils.InfraInfo)
+    accelerators: Optional[Dict[str, int]] = None
+    cpus: Optional[str] = None          # '4', '4+'
+    memory: Optional[str] = None        # '32', '32+' (GB)
+    instance_type: Optional[str] = None
+    use_spot: bool = False
+    spot_recovery: Optional[str] = None
+    disk_size: int = _DEFAULT_DISK_SIZE_GB
+    disk_tier: Optional[str] = None     # 'low'|'medium'|'high'|'ultra'|'best'
+    network_tier: Optional[str] = None  # 'standard'|'best' (ICI implied on TPU)
+    ports: Optional[List[str]] = None
+    image_id: Optional[str] = None
+    labels: Optional[Dict[str, str]] = None
+    autostop: Optional[AutostopConfig] = None
+    runtime_version: Optional[str] = None  # TPU VM runtime; default per gen
+    topology: Optional[str] = None         # explicit ICI topology '4x4x8'
+    job_recovery: Optional[str] = None     # managed-jobs strategy name
+    priority: Optional[int] = None
+
+    # ----- derived -----------------------------------------------------------
+    @property
+    def cloud(self) -> Optional[str]:
+        return self.infra.cloud
+
+    @property
+    def region(self) -> Optional[str]:
+        return self.infra.region
+
+    @property
+    def zone(self) -> Optional[str]:
+        return self.infra.zone
+
+    @property
+    def accelerator_name(self) -> Optional[str]:
+        if not self.accelerators:
+            return None
+        return next(iter(self.accelerators))
+
+    @property
+    def accelerator_count(self) -> int:
+        if not self.accelerators:
+            return 0
+        return next(iter(self.accelerators.values()))
+
+    def __post_init__(self) -> None:
+        # Validate an explicit topology against the slice chip count up front
+        # rather than failing late at provision time.
+        if self.topology is not None and self.accelerators:
+            name = next(iter(self.accelerators))
+            if acc_lib.is_tpu(name):
+                tpu = acc_lib.parse_tpu(name)
+                dims = [int(d) for d in self.topology.lower().split('x')]
+                prod = 1
+                for d in dims:
+                    prod *= d
+                if prod != tpu.num_chips or len(dims) != tpu.gen.ici_dims:
+                    raise exceptions.InvalidResourcesError(
+                        f'topology {self.topology!r} ({len(dims)}D, {prod} '
+                        f'chips) does not match {name} '
+                        f'({tpu.gen.ici_dims}D, {tpu.num_chips} chips).')
+
+    @property
+    def tpu(self) -> Optional[acc_lib.TpuType]:
+        name = self.accelerator_name
+        if name is not None and acc_lib.is_tpu(name):
+            t = acc_lib.parse_tpu(name)
+            if self.topology is not None:
+                t = dataclasses.replace(t, topology=self.topology)
+            return t
+        return None
+
+    @property
+    def is_tpu(self) -> bool:
+        return self.tpu is not None
+
+    @property
+    def is_tpu_pod(self) -> bool:
+        tpu = self.tpu
+        return tpu is not None and tpu.is_pod
+
+    @property
+    def hosts_per_node(self) -> int:
+        """Worker fan-out: a TPU-pod 'node' is num_hosts host VMs (analog of
+        reference num_ips_per_node, cloud_vm_ray_backend.py:2485,:5940)."""
+        tpu = self.tpu
+        return tpu.num_hosts if tpu is not None else 1
+
+    @property
+    def tpu_runtime_version(self) -> Optional[str]:
+        if self.runtime_version is not None:
+            return self.runtime_version
+        tpu = self.tpu
+        return tpu.runtime_version if tpu is not None else None
+
+    def is_launchable(self) -> bool:
+        if self.cloud is None:
+            return False
+        if self.cloud == 'local':
+            return True
+        return self.region is not None and (self.is_tpu or
+                                            self.instance_type is not None)
+
+    # ----- construction ------------------------------------------------------
+    def copy(self, **override) -> 'Resources':
+        """Immutable update (reference Resources.copy)."""
+        if 'infra' in override and isinstance(override['infra'], str):
+            override['infra'] = infra_utils.InfraInfo.from_str(
+                override['infra'])
+        if 'accelerators' in override and not isinstance(
+                override['accelerators'], (dict, type(None))):
+            override['accelerators'] = _parse_accelerators(
+                override['accelerators'])
+        return dataclasses.replace(self, **override)
+
+    @classmethod
+    def from_yaml_config(cls, config: Optional[Dict[str, Any]]) -> 'Resources':
+        if config is None:
+            config = {}
+        config = dict(config)
+        known = {
+            'infra', 'accelerators', 'cpus', 'memory', 'instance_type',
+            'use_spot', 'spot_recovery', 'disk_size', 'disk_tier',
+            'network_tier', 'ports', 'image_id', 'labels', 'autostop',
+            'runtime_version', 'topology', 'job_recovery', 'priority',
+            'accelerator_args', 'any_of',
+        }
+        unknown = set(config) - known
+        if unknown:
+            raise exceptions.InvalidResourcesError(
+                f'Unknown resources fields: {sorted(unknown)}')
+        # Reference compat: accelerator_args: {runtime_version: ...}
+        acc_args = config.pop('accelerator_args', None) or {}
+        ports = config.get('ports')
+        if ports is not None and not isinstance(ports, list):
+            ports = [str(ports)]
+        elif ports is not None:
+            ports = [str(p) for p in ports]
+        cpus = config.get('cpus')
+        memory = config.get('memory')
+        return cls(
+            infra=infra_utils.InfraInfo.from_str(config.get('infra')),
+            accelerators=_parse_accelerators(config.get('accelerators')),
+            cpus=str(cpus) if cpus is not None else None,
+            memory=str(memory) if memory is not None else None,
+            instance_type=config.get('instance_type'),
+            use_spot=bool(config.get('use_spot', False)),
+            spot_recovery=config.get('spot_recovery'),
+            disk_size=int(config.get('disk_size', _DEFAULT_DISK_SIZE_GB)),
+            disk_tier=config.get('disk_tier'),
+            network_tier=config.get('network_tier'),
+            ports=ports,
+            image_id=config.get('image_id'),
+            labels=config.get('labels'),
+            autostop=AutostopConfig.from_yaml_config(config.get('autostop')),
+            runtime_version=config.get('runtime_version',
+                                       acc_args.get('runtime_version')),
+            topology=config.get('topology'),
+            job_recovery=config.get('job_recovery'),
+            priority=config.get('priority'),
+        )
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        infra = self.infra.to_str()
+        if infra:
+            out['infra'] = infra
+        if self.accelerators:
+            name, cnt = self.accelerator_name, self.accelerator_count
+            out['accelerators'] = name if (self.is_tpu or
+                                           cnt == 1) else f'{name}:{cnt}'
+        for field, val, default in (
+            ('cpus', self.cpus, None), ('memory', self.memory, None),
+            ('instance_type', self.instance_type, None),
+            ('use_spot', self.use_spot, False),
+            ('spot_recovery', self.spot_recovery, None),
+            ('disk_size', self.disk_size, _DEFAULT_DISK_SIZE_GB),
+            ('disk_tier', self.disk_tier, None),
+            ('network_tier', self.network_tier, None),
+            ('ports', self.ports, None), ('image_id', self.image_id, None),
+            ('labels', self.labels, None),
+            ('runtime_version', self.runtime_version, None),
+            ('topology', self.topology, None),
+            ('job_recovery', self.job_recovery, None),
+            ('priority', self.priority, None),
+        ):
+            if val != default and val is not None:
+                out[field] = val
+        if self.autostop is not None and self.autostop.enabled:
+            out['autostop'] = self.autostop.to_yaml_config()
+        return out
+
+    # ----- comparison --------------------------------------------------------
+    def _cpu_mem_at_least(self, other: 'Resources') -> bool:
+
+        def _num(v: Optional[str]) -> Optional[float]:
+            if v is None:
+                return None
+            return float(str(v).rstrip('+'))
+
+        for mine, theirs in ((self.cpus, other.cpus),
+                             (self.memory, other.memory)):
+            m, t = _num(mine), _num(theirs)
+            if t is not None and (m is None or m < t):
+                return False
+        return True
+
+    def less_demanding_than(self, other: 'Resources') -> bool:
+        """True if `other` (an existing cluster) can serve this request
+        (reference: sky/resources.py:1647)."""
+        if self.cloud is not None and self.cloud != other.cloud:
+            return False
+        if self.region is not None and self.region != other.region:
+            return False
+        if self.zone is not None and self.zone != other.zone:
+            return False
+        if self.accelerators is not None:
+            if other.accelerators is None:
+                return False
+            for name, cnt in self.accelerators.items():
+                if other.accelerators.get(name, 0) < cnt:
+                    return False
+        if self.use_spot and not other.use_spot:
+            return False
+        return other._cpu_mem_at_least(self)  # pylint: disable=protected-access
+
+    def get_cost(self, seconds: float) -> float:
+        """Cost of holding these resources for `seconds` (uses catalog)."""
+        from skypilot_tpu import catalog  # lazy: avoid import cycle
+        hourly = catalog.get_hourly_cost(self)
+        return hourly * seconds / 3600.0
+
+    def __hash__(self) -> int:
+        # Frozen dataclass with dict/list fields: hash a canonical tuple form
+        # so Resources can live in Task.resources sets.
+
+        def _freeze(v: Any) -> Any:
+            if isinstance(v, dict):
+                return tuple(sorted((k, _freeze(x)) for k, x in v.items()))
+            if isinstance(v, list):
+                return tuple(_freeze(x) for x in v)
+            return v
+
+        return hash(tuple(
+            _freeze(getattr(self, f.name)) for f in dataclasses.fields(self)))
+
+    def __repr__(self) -> str:
+        parts = [str(self.infra)]
+        if self.accelerators:
+            name, cnt = self.accelerator_name, self.accelerator_count
+            parts.append(name if self.is_tpu else f'{name}:{cnt}')
+        if self.instance_type:
+            parts.append(self.instance_type)
+        if self.use_spot:
+            parts.append('[spot]')
+        return f'Resources({", ".join(parts)})'

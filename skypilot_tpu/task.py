@@ -1,0 +1,247 @@
+"""Task: the unit of work (capability parity: sky/task.py:241).
+
+A Task is what `launch` runs on a cluster: setup + run commands, env/secret
+vars, file and storage mounts, a resources set, and (for services) a service
+spec.  YAML round-trips.  `num_nodes` counts *logical* nodes; on a TPU pod
+slice one logical node fans out to `Resources.hosts_per_node` host VMs, every
+one of which runs `run` (reference: cloud_vm_ray_backend.py:5940).
+"""
+from __future__ import annotations
+
+import copy
+import dataclasses
+import os
+import re
+from typing import Any, Callable, Dict, List, Optional, Set, Union
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu.utils import common_utils
+
+_VALID_NAME_REGEX = re.compile(r'^[a-zA-Z0-9]+(?:[._-]{1,2}[a-zA-Z0-9]+)*$')
+_RUN_FN_TYPE = Callable[[int, List[str]], Optional[str]]
+
+
+class Task:
+    """A coarse-grained unit of work: setup once, run on every node."""
+
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        *,
+        setup: Optional[str] = None,
+        run: Optional[Union[str, _RUN_FN_TYPE]] = None,
+        envs: Optional[Dict[str, str]] = None,
+        secrets: Optional[Dict[str, str]] = None,
+        workdir: Optional[str] = None,
+        num_nodes: Optional[int] = None,
+        file_mounts: Optional[Dict[str, str]] = None,
+        storage_mounts: Optional[Dict[str, Any]] = None,
+        service: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        self.name = name
+        self.setup = setup
+        self.run = run
+        self.workdir = workdir
+        self.num_nodes = num_nodes if num_nodes is not None else 1
+        self._envs = dict(envs or {})
+        self._secrets = dict(secrets or {})
+        self.file_mounts = dict(file_mounts or {})
+        # Raw `storage_mounts` config; materialized into Storage objects by
+        # skypilot_tpu.data.storage at sync time.
+        self.storage_mounts = dict(storage_mounts or {})
+        self.service = service
+        self.resources: Set[resources_lib.Resources] = {
+            resources_lib.Resources()
+        }
+        # Filled by the optimizer.
+        self.best_resources: Optional[resources_lib.Resources] = None
+        self.estimated_runtime_s: Optional[float] = None
+        self._validate()
+
+    # ----- validation --------------------------------------------------------
+    def _validate(self) -> None:
+        if self.name is not None and not _VALID_NAME_REGEX.match(self.name):
+            raise exceptions.InvalidTaskError(
+                f'Invalid task name {self.name!r}; must match '
+                f'{_VALID_NAME_REGEX.pattern}')
+        if self.num_nodes < 1:
+            raise exceptions.InvalidTaskError(
+                f'num_nodes must be >= 1, got {self.num_nodes}')
+        if self.run is not None and not isinstance(self.run, str) and \
+                not callable(self.run):
+            raise exceptions.InvalidTaskError(
+                'run must be a shell-command string or a callable '
+                '(node_rank, node_ips) -> Optional[cmd]')
+        for key in list(self._envs) + list(self._secrets):
+            if not re.fullmatch(r'[A-Za-z_][A-Za-z0-9_]*', key):
+                raise exceptions.InvalidTaskError(
+                    f'Invalid env var name: {key!r}')
+        overlap = set(self._envs) & set(self._secrets)
+        if overlap:
+            raise exceptions.InvalidTaskError(
+                f'Variables in both envs and secrets: {sorted(overlap)}')
+        if self.workdir is not None:
+            expanded = os.path.expanduser(self.workdir)
+            if not os.path.isdir(expanded):
+                raise exceptions.InvalidTaskError(
+                    f'workdir {self.workdir!r} is not a directory')
+
+    # ----- envs/secrets ------------------------------------------------------
+    @property
+    def envs(self) -> Dict[str, str]:
+        return dict(self._envs)
+
+    @property
+    def secrets(self) -> Dict[str, str]:
+        return dict(self._secrets)
+
+    @property
+    def envs_and_secrets(self) -> Dict[str, str]:
+        out = dict(self._envs)
+        out.update(self._secrets)
+        return out
+
+    def update_envs(self, envs: Dict[str, str]) -> 'Task':
+        self._envs.update({k: str(v) for k, v in envs.items()})
+        self._validate()
+        return self
+
+    def update_secrets(self, secrets: Dict[str, str]) -> 'Task':
+        self._secrets.update({k: str(v) for k, v in secrets.items()})
+        self._validate()
+        return self
+
+    # ----- resources ---------------------------------------------------------
+    def set_resources(
+        self, resources: Union[resources_lib.Resources,
+                               Set[resources_lib.Resources],
+                               List[resources_lib.Resources]]
+    ) -> 'Task':
+        if isinstance(resources, resources_lib.Resources):
+            resources = {resources}
+        self.resources = set(resources)
+        if not self.resources:
+            raise exceptions.InvalidTaskError('resources set is empty')
+        return self
+
+    @property
+    def any_resources(self) -> resources_lib.Resources:
+        return next(iter(self.resources))
+
+    # ----- YAML round-trip ---------------------------------------------------
+    @classmethod
+    def from_yaml_config(cls, config: Dict[str, Any]) -> 'Task':
+        """Build from a task-YAML dict (reference: sky/task.py:544)."""
+        from skypilot_tpu.utils import schemas  # local: avoid cycle
+        schemas.validate_task_config(config)
+        config = copy.deepcopy(config)  # never mutate the caller's dict
+        envs = {
+            k: ('' if v is None else str(v))
+            for k, v in (config.get('envs') or {}).items()
+        }
+        secrets = {
+            k: ('' if v is None else str(v))
+            for k, v in (config.get('secrets') or {}).items()
+        }
+        task = cls(
+            config.get('name'),
+            setup=config.get('setup'),
+            run=config.get('run'),
+            envs=envs,
+            secrets=secrets,
+            workdir=config.get('workdir'),
+            num_nodes=config.get('num_nodes'),
+            file_mounts={
+                k: v for k, v in (config.get('file_mounts') or {}).items()
+                if isinstance(v, str)
+            },
+            storage_mounts={
+                k: v for k, v in (config.get('file_mounts') or {}).items()
+                if isinstance(v, dict)
+            },
+            service=config.get('service'),
+        )
+        res_config = config.get('resources')
+        if res_config is not None:
+            any_of = res_config.pop('any_of', None) if isinstance(
+                res_config, dict) else None
+            base = resources_lib.Resources.from_yaml_config(res_config)
+            if any_of:
+                task.set_resources(
+                    {_merge_resources(base, alt) for alt in any_of})
+            else:
+                task.set_resources(base)
+        return task
+
+    @classmethod
+    def from_yaml(cls, path: str) -> 'Task':
+        configs = common_utils.read_yaml_all(path)
+        if not configs:
+            raise exceptions.InvalidTaskError(f'Empty task YAML: {path}')
+        if len(configs) > 1:
+            raise exceptions.InvalidTaskError(
+                f'{path} contains multiple documents; use load_chain_dag '
+                'for pipelines.')
+        return cls.from_yaml_config(configs[0])
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        if self.name:
+            out['name'] = self.name
+        res = (self.best_resources or self.any_resources).to_yaml_config()
+        if res:
+            out['resources'] = res
+        if self.num_nodes != 1:
+            out['num_nodes'] = self.num_nodes
+        if self.workdir:
+            out['workdir'] = self.workdir
+        file_mounts: Dict[str, Any] = {}
+        file_mounts.update(self.file_mounts)
+        file_mounts.update(self.storage_mounts)
+        if file_mounts:
+            out['file_mounts'] = file_mounts
+        if self.setup:
+            out['setup'] = self.setup
+        if isinstance(self.run, str) and self.run:
+            out['run'] = self.run
+        if self._envs:
+            out['envs'] = dict(self._envs)
+        if self._secrets:
+            out['secrets'] = dict(self._secrets)
+        if self.service:
+            out['service'] = self.service
+        return out
+
+    # ----- DAG sugar ---------------------------------------------------------
+    def __rshift__(self, other: 'Task') -> 'Task':
+        """`a >> b` adds edge a→b in the ambient Dag context
+        (reference: sky/task.py:1779)."""
+        from skypilot_tpu import dag as dag_lib
+        ctx = dag_lib.get_current_dag()
+        if ctx is None:
+            raise exceptions.InvalidDagError(
+                'Task >> Task requires an active `with Dag() as dag:` block.')
+        ctx.add_edge(self, other)
+        return other
+
+    def __repr__(self) -> str:
+        name = self.name or '<unnamed>'
+        res = self.best_resources or self.any_resources
+        return f'Task({name}, nodes={self.num_nodes}, {res})'
+
+
+def _merge_resources(base: resources_lib.Resources,
+                     override_config: Dict[str, Any]) -> resources_lib.Resources:
+    """Apply an `any_of:` alternative on top of the base resources config."""
+    parsed = resources_lib.Resources.from_yaml_config(override_config)
+    overrides = {
+        field: getattr(parsed, field)
+        for field in override_config
+        if field in {f.name for f in dataclasses.fields(parsed)}
+    }
+    if 'infra' in override_config:
+        overrides['infra'] = parsed.infra
+    if 'accelerators' in override_config:
+        overrides['accelerators'] = parsed.accelerators
+    return base.copy(**overrides)

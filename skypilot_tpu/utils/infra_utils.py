@@ -1,0 +1,61 @@
+"""Parsing of `infra:` strings — `cloud[/region[/zone]]`.
+
+Capability parity with the reference's `sky/utils/infra_utils.py` (the `infra:`
+field of task YAML), with a reduced cloud set centered on GCP TPU, a local
+process cloud for dev/tests, and kubernetes reserved for later.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from skypilot_tpu import exceptions
+
+KNOWN_CLOUDS = ('gcp', 'local', 'kubernetes')
+WILDCARD = '*'
+
+
+@dataclasses.dataclass(frozen=True)
+class InfraInfo:
+    cloud: Optional[str] = None
+    region: Optional[str] = None
+    zone: Optional[str] = None
+
+    @classmethod
+    def from_str(cls, infra: Optional[str]) -> 'InfraInfo':
+        if infra is None or not str(infra).strip():
+            return cls()
+        parts = [p.strip() for p in str(infra).strip().strip('/').split('/')]
+        if len(parts) > 3:
+            raise exceptions.InvalidInfraError(
+                f'Invalid infra string {infra!r}: expected '
+                "'cloud[/region[/zone]]'.")
+        parts += [None] * (3 - len(parts))
+        cloud, region, zone = parts
+        if cloud in (WILDCARD, ''):
+            cloud = None
+        if cloud is not None:
+            cloud = cloud.lower()
+            if cloud not in KNOWN_CLOUDS:
+                raise exceptions.InvalidInfraError(
+                    f'Unknown cloud {cloud!r} in infra {infra!r}. '
+                    f'Known: {KNOWN_CLOUDS}')
+        if region in (WILDCARD, ''):
+            region = None
+        if zone in (WILDCARD, ''):
+            zone = None
+        if zone is not None and region is None:
+            raise exceptions.InvalidInfraError(
+                f'Invalid infra {infra!r}: zone given without region.')
+        return cls(cloud, region, zone)
+
+    def to_str(self) -> Optional[str]:
+        parts = []
+        for p in (self.cloud, self.region, self.zone):
+            if p is None:
+                break
+            parts.append(p)
+        return '/'.join(parts) if parts else None
+
+    def __str__(self) -> str:
+        return self.to_str() or '*'

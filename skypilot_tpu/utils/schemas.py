@@ -1,0 +1,194 @@
+"""JSON-schema validation of task YAML and layered config
+(capability parity: sky/utils/schemas.py, 1899 LoC in the reference).
+
+Kept deliberately small: one schema per document type, validated with
+`jsonschema`.  Error messages are rewritten to point at the offending field.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jsonschema
+
+from skypilot_tpu import exceptions
+
+_RESOURCES_SCHEMA: Dict[str, Any] = {
+    'type': 'object',
+    'additionalProperties': False,
+    'properties': {
+        'infra': {'type': 'string'},
+        'accelerators': {
+            'anyOf': [{'type': 'string'},
+                      {'type': 'object',
+                       'additionalProperties': {'type': 'integer'}}]
+        },
+        'cpus': {'anyOf': [{'type': 'string'}, {'type': 'number'}]},
+        'memory': {'anyOf': [{'type': 'string'}, {'type': 'number'}]},
+        'instance_type': {'type': 'string'},
+        'use_spot': {'type': 'boolean'},
+        'spot_recovery': {'type': 'string'},
+        'disk_size': {'type': 'integer', 'minimum': 1},
+        'disk_tier': {'enum': ['low', 'medium', 'high', 'ultra', 'best']},
+        'network_tier': {'enum': ['standard', 'best']},
+        'ports': {
+            'anyOf': [{'type': 'string'}, {'type': 'integer'},
+                      {'type': 'array',
+                       'items': {'anyOf': [{'type': 'string'},
+                                           {'type': 'integer'}]}}]
+        },
+        'image_id': {'type': 'string'},
+        'labels': {'type': 'object', 'additionalProperties': {'type': 'string'}},
+        'autostop': {
+            'anyOf': [{'type': 'boolean'}, {'type': 'integer'},
+                      {'type': 'object'}]
+        },
+        'runtime_version': {'type': 'string'},
+        'topology': {'type': 'string', 'pattern': r'^\d+x\d+(x\d+)?$'},
+        'job_recovery': {
+            'anyOf': [{'type': 'string'}, {'type': 'object'}]
+        },
+        'priority': {'type': 'integer', 'minimum': -1000, 'maximum': 1000},
+        'accelerator_args': {'type': 'object'},
+        'any_of': {'type': 'array', 'items': {'type': 'object'}},
+    },
+}
+
+_SERVICE_SCHEMA: Dict[str, Any] = {
+    'type': 'object',
+    'additionalProperties': False,
+    'required': ['readiness_probe'],
+    'properties': {
+        'readiness_probe': {
+            'anyOf': [
+                {'type': 'string'},
+                {'type': 'object',
+                 'additionalProperties': False,
+                 'required': ['path'],
+                 'properties': {
+                     'path': {'type': 'string'},
+                     'initial_delay_seconds': {'type': 'number'},
+                     'timeout_seconds': {'type': 'number'},
+                     'post_data': {'type': ['object', 'string']},
+                 }},
+            ]
+        },
+        'replica_policy': {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {
+                'min_replicas': {'type': 'integer', 'minimum': 0},
+                'max_replicas': {'type': 'integer', 'minimum': 0},
+                'target_qps_per_replica': {'type': 'number'},
+                'upscale_delay_seconds': {'type': 'number'},
+                'downscale_delay_seconds': {'type': 'number'},
+                'dynamic_ondemand_fallback': {'type': 'boolean'},
+                'base_ondemand_fallback_replicas': {'type': 'integer'},
+            },
+        },
+        'replicas': {'type': 'integer', 'minimum': 0},
+        'load_balancing_policy': {
+            'enum': ['round_robin', 'least_load', 'instance_aware']
+        },
+    },
+}
+
+TASK_SCHEMA: Dict[str, Any] = {
+    'type': 'object',
+    'additionalProperties': False,
+    'properties': {
+        'name': {'type': 'string'},
+        'workdir': {'type': 'string'},
+        'num_nodes': {'type': 'integer', 'minimum': 1},
+        'resources': _RESOURCES_SCHEMA,
+        'file_mounts': {'type': 'object'},
+        'setup': {'type': 'string'},
+        'run': {'type': 'string'},
+        'envs': {
+            'type': 'object',
+            'additionalProperties': {
+                'anyOf': [{'type': 'string'}, {'type': 'number'},
+                          {'type': 'boolean'}, {'type': 'null'}]
+            }
+        },
+        'secrets': {
+            'type': 'object',
+            'additionalProperties': {
+                'anyOf': [{'type': 'string'}, {'type': 'number'},
+                          {'type': 'null'}]
+            }
+        },
+        'service': _SERVICE_SCHEMA,
+    },
+}
+
+CONFIG_SCHEMA: Dict[str, Any] = {
+    'type': 'object',
+    'additionalProperties': False,
+    'properties': {
+        'api_server': {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {
+                'endpoint': {'type': 'string'},
+                'workers': {'type': 'integer'},
+            },
+        },
+        'gcp': {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {
+                'project_id': {'type': 'string'},
+                'use_queued_resources': {'type': 'boolean'},
+                'queued_resource_timeout_s': {'type': 'number'},
+                'reservation': {'type': 'string'},
+                'labels': {'type': 'object'},
+            },
+        },
+        'jobs': {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {
+                'controller': {'type': 'object'},
+                'max_parallel': {'type': 'integer'},
+            },
+        },
+        'serve': {'type': 'object'},
+        'allowed_clouds': {'type': 'array', 'items': {'type': 'string'}},
+        'optimizer': {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {
+                'minimize': {'enum': ['cost', 'time']},
+            },
+        },
+        'logs': {'type': 'object'},
+        'admin_policy': {'type': 'string'},
+    },
+}
+
+
+def _validate(config: Dict[str, Any], schema: Dict[str, Any],
+              what: str) -> None:
+    try:
+        jsonschema.validate(config, schema)
+    except jsonschema.ValidationError as e:
+        path = '.'.join(str(p) for p in e.absolute_path) or '<root>'
+        raise exceptions.InvalidTaskError(
+            f'Invalid {what} at {path!r}: {e.message}') from e
+
+
+def validate_task_config(config: Dict[str, Any]) -> None:
+    _validate(config, TASK_SCHEMA, 'task YAML')
+
+
+def validate_config(config: Dict[str, Any]) -> None:
+    try:
+        jsonschema.validate(config, CONFIG_SCHEMA)
+    except jsonschema.ValidationError as e:
+        path = '.'.join(str(p) for p in e.absolute_path) or '<root>'
+        raise exceptions.InvalidSkyConfigError(
+            f'Invalid config at {path!r}: {e.message}') from e
+
+
+def validate_service_config(config: Dict[str, Any]) -> None:
+    _validate(config, _SERVICE_SCHEMA, 'service spec')

@@ -1,0 +1,30 @@
+"""Test config: force an 8-device virtual CPU mesh before jax is imported.
+
+All sharding/parallelism tests run against this virtual mesh so they exercise
+the same pjit/shard_map code paths that run on real TPU slices.
+"""
+import os
+
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+xla_flags = os.environ.get('XLA_FLAGS', '')
+if '--xla_force_host_platform_device_count' not in xla_flags:
+    os.environ['XLA_FLAGS'] = (
+        xla_flags + ' --xla_force_host_platform_device_count=8').strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def tmp_home(tmp_path, monkeypatch):
+    """Isolated $HOME so state DBs/config files never touch the real one."""
+    home = tmp_path / 'home'
+    home.mkdir()
+    monkeypatch.setenv('HOME', str(home))
+    monkeypatch.setenv('SKYTPU_GLOBAL_CONFIG',
+                       str(home / '.skytpu' / 'config.yaml'))
+    monkeypatch.setenv('SKYTPU_PROJECT_CONFIG',
+                       str(home / '.skytpu.yaml'))
+    from skypilot_tpu import sky_config
+    sky_config.reset_cache_for_tests()
+    yield home
+    sky_config.reset_cache_for_tests()

@@ -1,0 +1,79 @@
+"""Accelerator registry: TPU parsing, topology, host fan-out."""
+import pytest
+
+from skypilot_tpu import accelerators as acc
+from skypilot_tpu import exceptions
+
+
+def test_parse_basic():
+    t = acc.parse_tpu('tpu-v5p-128')
+    assert t.generation == 'v5p'
+    assert t.count_suffix == 128
+    assert t.num_chips == 64          # v5p suffix counts cores, 2 cores/chip
+    assert t.num_hosts == 16          # 4 chips/host
+    assert t.is_pod
+    assert t.name == 'tpu-v5p-128'
+    assert t.gcp_accelerator_type == 'v5p-128'
+
+
+@pytest.mark.parametrize('s,gen,chips,hosts', [
+    ('tpu-v4-8', 'v4', 4, 1),
+    ('tpu-v4-32', 'v4', 16, 4),
+    ('tpu-v2-8', 'v2', 4, 1),
+    ('tpu-v3-32', 'v3', 16, 4),
+    ('tpu-v5litepod-8', 'v5litepod', 8, 1),
+    ('tpu-v5e-16', 'v5litepod', 16, 4),
+    ('tpu-v6e-8', 'v6e', 8, 1),
+    ('tpu-v6e-16', 'v6e', 16, 4),      # matches reference 4-host observation
+    ('tpu-v6e:8', 'v6e', 8, 1),
+    ('tpu-v5p-8', 'v5p', 4, 1),
+])
+def test_parse_matrix(s, gen, chips, hosts):
+    t = acc.parse_tpu(s)
+    assert t.generation == gen
+    assert t.num_chips == chips
+    assert t.num_hosts == hosts
+
+
+def test_is_tpu():
+    assert acc.is_tpu('tpu-v6e-8')
+    assert acc.is_tpu('tpu-v5p-128')
+    assert not acc.is_tpu('A100')
+    assert not acc.is_tpu(None)
+    assert not acc.is_tpu('gpu-v100')
+
+
+def test_invalid():
+    with pytest.raises(exceptions.InvalidAcceleratorError):
+        acc.parse_tpu('tpu-v99-8')
+    with pytest.raises(exceptions.InvalidAcceleratorError):
+        acc.parse_tpu('A100')
+    with pytest.raises(exceptions.InvalidAcceleratorError):
+        acc.parse_tpu('tpu-v5p-7')    # cores not multiple of 2
+
+
+def test_default_topology_2d():
+    t = acc.parse_tpu('tpu-v6e-16')
+    x, y = t.default_topology()
+    assert x * y == 16
+
+
+def test_default_topology_3d():
+    t = acc.parse_tpu('tpu-v5p-256')  # 128 chips
+    dims = t.default_topology()
+    assert len(dims) == 3
+    prod = 1
+    for d in dims:
+        prod *= d
+    assert prod == 128
+
+
+def test_canonicalize_gpu():
+    assert acc.canonicalize('a100') == 'A100'
+    assert acc.canonicalize('tpu-v5e-8') == 'tpu-v5litepod-8'
+
+
+def test_flops_and_hbm():
+    t = acc.parse_tpu('tpu-v6e-8')
+    assert t.bf16_tflops == 8 * 918
+    assert t.hbm_gb == 8 * 32

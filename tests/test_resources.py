@@ -1,0 +1,86 @@
+"""Resources: parsing, immutability, YAML round-trip, comparison."""
+import pytest
+
+from skypilot_tpu import Resources, exceptions
+from skypilot_tpu.resources import AutostopConfig
+
+
+def test_default():
+    r = Resources()
+    assert r.cloud is None
+    assert r.accelerators is None
+    assert not r.is_launchable()
+
+
+def test_tpu_from_yaml():
+    r = Resources.from_yaml_config({
+        'infra': 'gcp/us-central2/us-central2-b',
+        'accelerators': 'tpu-v4-32',
+        'use_spot': True,
+    })
+    assert r.cloud == 'gcp'
+    assert r.region == 'us-central2'
+    assert r.zone == 'us-central2-b'
+    assert r.is_tpu and r.is_tpu_pod
+    assert r.accelerator_name == 'tpu-v4-32'
+    assert r.hosts_per_node == 4
+    assert r.use_spot
+    assert r.is_launchable()
+    assert r.tpu_runtime_version == 'tpu-vm-v4-base'
+
+
+def test_yaml_round_trip():
+    config = {
+        'infra': 'gcp/us-east5',
+        'accelerators': 'tpu-v5p-8',
+        'disk_size': 512,
+        'use_spot': True,
+        'labels': {'team': 'ml'},
+    }
+    r = Resources.from_yaml_config(config)
+    r2 = Resources.from_yaml_config(r.to_yaml_config())
+    assert r == r2
+
+
+def test_copy_immutable():
+    r = Resources.from_yaml_config({'accelerators': 'tpu-v6e-8'})
+    r2 = r.copy(use_spot=True, infra='gcp/us-east1')
+    assert not r.use_spot
+    assert r2.use_spot and r2.region == 'us-east1'
+    assert r2.accelerator_name == 'tpu-v6e-8'
+
+
+def test_gpu_count():
+    r = Resources.from_yaml_config({'accelerators': 'A100:8'})
+    assert r.accelerator_name == 'A100'
+    assert r.accelerator_count == 8
+    assert not r.is_tpu
+
+
+def test_autostop_forms():
+    assert AutostopConfig.from_yaml_config(None) is None
+    assert AutostopConfig.from_yaml_config(True).enabled
+    assert AutostopConfig.from_yaml_config(10).idle_minutes == 10
+    c = AutostopConfig.from_yaml_config({'idle_minutes': 3, 'down': True})
+    assert c.idle_minutes == 3 and c.down
+
+
+def test_less_demanding_than():
+    small = Resources.from_yaml_config({'accelerators': 'tpu-v6e-8'})
+    cluster = Resources.from_yaml_config({
+        'infra': 'gcp/us-east1/us-east1-d', 'accelerators': 'tpu-v6e-8'})
+    assert small.less_demanding_than(cluster)
+    bigger = Resources.from_yaml_config({'accelerators': 'tpu-v6e-16'})
+    assert not bigger.less_demanding_than(cluster)
+
+
+def test_unknown_field():
+    with pytest.raises(exceptions.InvalidResourcesError):
+        Resources.from_yaml_config({'acelerators': 'tpu-v4-8'})
+
+
+def test_bad_infra():
+    with pytest.raises(exceptions.InvalidInfraError):
+        Resources.from_yaml_config({'infra': 'aws/us-east-1/x/y'})
+    with pytest.raises(exceptions.InvalidInfraError):
+        Resources.from_yaml_config({'infra': 'ec2'})

@@ -1,0 +1,116 @@
+"""Task YAML round-trip, validation, DAG construction."""
+import textwrap
+
+import pytest
+
+from skypilot_tpu import Dag, Task, exceptions
+from skypilot_tpu import dag as dag_lib
+
+TASK_YAML = textwrap.dedent("""\
+    name: train-llama
+    resources:
+      infra: gcp
+      accelerators: tpu-v5p-128
+      use_spot: true
+    num_nodes: 1
+    envs:
+      MODEL: llama3-8b
+      LR: 3e-4
+    secrets:
+      HF_TOKEN: null
+    setup: |
+      pip install -e .
+    run: |
+      python -m skypilot_tpu.recipes.train --model $MODEL
+    """)
+
+
+def test_task_from_yaml(tmp_path):
+    p = tmp_path / 'task.yaml'
+    p.write_text(TASK_YAML)
+    t = Task.from_yaml(str(p))
+    assert t.name == 'train-llama'
+    assert t.num_nodes == 1
+    assert t.envs['MODEL'] == 'llama3-8b'
+    assert t.envs['LR'] == '3e-4'
+    assert 'HF_TOKEN' in t.secrets
+    r = t.any_resources
+    assert r.accelerator_name == 'tpu-v5p-128'
+    assert r.use_spot
+
+
+def test_task_round_trip(tmp_path):
+    p = tmp_path / 'task.yaml'
+    p.write_text(TASK_YAML)
+    t = Task.from_yaml(str(p))
+    t2 = Task.from_yaml_config(t.to_yaml_config())
+    assert t2.name == t.name
+    assert t2.any_resources == t.any_resources
+    assert t2.envs == t.envs
+
+
+def test_invalid_env_name():
+    with pytest.raises(exceptions.InvalidTaskError):
+        Task(envs={'1BAD': 'x'})
+
+
+def test_env_secret_overlap():
+    with pytest.raises(exceptions.InvalidTaskError):
+        Task(envs={'A': '1'}, secrets={'A': '2'})
+
+
+def test_schema_rejects_unknown_top_level():
+    with pytest.raises(exceptions.InvalidTaskError):
+        Task.from_yaml_config({'runn': 'echo hi'})
+
+
+def test_dag_chain():
+    with Dag('pipe') as dag:
+        a = Task('a', run='echo a')
+        b = Task('b', run='echo b')
+        c = Task('c', run='echo c')
+        a >> b >> c
+    assert dag.is_chain()
+    order = dag.topological_order()
+    assert [t.name for t in order] == ['a', 'b', 'c']
+
+
+def test_dag_not_chain():
+    dag = Dag('diamond')
+    a, b, c = Task('a'), Task('b'), Task('c')
+    dag.add_edge(a, b)
+    dag.add_edge(a, c)
+    assert not dag.is_chain()
+
+
+def test_chain_dag_from_yaml(tmp_path):
+    p = tmp_path / 'pipe.yaml'
+    p.write_text(textwrap.dedent("""\
+        name: my-pipeline
+        ---
+        name: stage1
+        run: echo one
+        ---
+        name: stage2
+        run: echo two
+        """))
+    dag = dag_lib.load_chain_dag_from_yaml(str(p))
+    assert dag.name == 'my-pipeline'
+    assert dag.is_chain()
+    assert [t.name for t in dag.topological_order()] == ['stage1', 'stage2']
+
+
+def test_any_of_resources():
+    t = Task.from_yaml_config({
+        'name': 'flex',
+        'resources': {
+            'use_spot': True,
+            'any_of': [
+                {'accelerators': 'tpu-v5p-8'},
+                {'accelerators': 'tpu-v6e-8'},
+            ],
+        },
+    })
+    names = sorted(r.accelerator_name for r in t.resources)
+    assert names == ['tpu-v5p-8', 'tpu-v6e-8']
+    assert all(r.use_spot for r in t.resources)
