@@ -28,8 +28,9 @@ class TpuGeneration:
     cores_per_chip: int           # suffix in GCP type names counts cores for
                                   # v2..v5p, chips for v5e/v6e
     suffix_counts_chips: bool     # True for v5litepod / v6e
-    chips_per_host: int           # chips on one host VM (full host)
-    small_slice_host_chips: int   # chips/host for single-host slices
+    multi_host_chips: int         # chips/host in multi-host slices (4 on all
+                                  # current generations)
+    small_slice_host_chips: int   # max chips of a single-host slice
     hbm_gb_per_chip: float
     bf16_tflops_per_chip: float   # peak dense bf16
     int8_tops_per_chip: float
@@ -52,13 +53,13 @@ GENERATIONS: Dict[str, TpuGeneration] = {
                         'tpu-vm-v4-base', 240, 400, min_chips=4,
                         max_chips=4096),
     'v5litepod': TpuGeneration('v5litepod', ('v5litepod', 'v5e', 'v5lite'), 1,
-                               True, 8, 8, 16, 197, 394, 2,
+                               True, 4, 8, 16, 197, 394, 2,
                                'v2-alpha-tpuv5-lite', 224, 400, min_chips=1,
                                max_chips=256),
     'v5p': TpuGeneration('v5p', ('v5p',), 2, False, 4, 4, 95, 459, 918, 3,
                          'v2-alpha-tpuv5', 208, 448, min_chips=4,
                          max_chips=6144),
-    'v6e': TpuGeneration('v6e', ('v6e', 'trillium'), 1, True, 8, 8, 32, 918,
+    'v6e': TpuGeneration('v6e', ('v6e', 'trillium'), 1, True, 4, 8, 32, 918,
                          1836, 2, 'v2-alpha-tpuv6e', 180, 720, min_chips=1,
                          max_chips=256),
 }
@@ -111,7 +112,7 @@ class TpuType:
         chips = self.num_chips
         if chips <= g.small_slice_host_chips:
             return 1
-        return max(1, math.ceil(chips / 4))
+        return max(1, math.ceil(chips / g.multi_host_chips))
 
     @property
     def chips_per_host(self) -> int:
@@ -216,12 +217,12 @@ def parse_tpu(accelerator: str) -> TpuType:
         raise exceptions.InvalidAcceleratorError(
             f'{accelerator!r}: {chips} chips out of range '
             f'[{g.min_chips}, {g.max_chips}] for {gen}.')
-    # Multi-host slices always use 4-chip hosts, so the chip count must tile
-    # exactly; otherwise the gang executor would see an inconsistent slice.
-    if chips > g.small_slice_host_chips and chips % 4 != 0:
+    # Multi-host slice chip counts must tile exactly onto hosts; otherwise
+    # the gang executor would see an inconsistent slice.
+    if chips > g.small_slice_host_chips and chips % g.multi_host_chips != 0:
         raise exceptions.InvalidAcceleratorError(
-            f'{accelerator!r}: multi-host slices need a multiple of 4 chips, '
-            f'got {chips}.')
+            f'{accelerator!r}: multi-host slices need a multiple of '
+            f'{g.multi_host_chips} chips, got {chips}.')
     if chips <= g.small_slice_host_chips and chips not in (1, 2, 4, 8):
         raise exceptions.InvalidAcceleratorError(
             f'{accelerator!r}: single-host slice sizes are 1/2/4/8 chips, '

@@ -1,0 +1,108 @@
+"""Catalog facade — cloud-dispatched pricing/feasibility queries.
+
+Parity target: `sky/catalog/__init__.py` (per-cloud `*_catalog.py` modules
+behind one facade).  Clouds here: `gcp` (TPU-first) and `local` (free,
+always-feasible, used by dev/tests the way the reference uses mocked clouds).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, TYPE_CHECKING
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.catalog import gcp_catalog
+from skypilot_tpu.catalog.gcp_catalog import TpuOffering
+
+if TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+__all__ = [
+    'TpuOffering', 'get_hourly_cost', 'list_accelerators', 'list_offerings',
+    'get_regions', 'get_zones', 'get_default_instance_type', 'gcp_catalog',
+]
+
+
+def get_hourly_cost(resources: 'resources_lib.Resources') -> float:
+    """$/hr for one node of `resources` (cheapest placement if region/zone
+    unset).  TPU slice prices include the host VMs."""
+    cloud = resources.cloud
+    if cloud == 'local':
+        return 0.0
+    if resources.is_tpu:
+        tpu = resources.tpu
+        assert tpu is not None
+        return gcp_catalog.get_tpu_hourly_cost(tpu.name,
+                                               region=resources.region,
+                                               zone=resources.zone,
+                                               use_spot=resources.use_spot)
+    if resources.instance_type is not None:
+        return gcp_catalog.get_vm_hourly_cost(resources.instance_type,
+                                              use_spot=resources.use_spot)
+    if resources.accelerators:
+        raise exceptions.ResourcesUnavailableError(
+            f'No GPU offerings in the GCP catalog for '
+            f'{resources.accelerator_name}; this build is TPU-first. '
+            f'Use accelerators: tpu-<gen>-<size>.')
+    # CPU-only with no instance type: price the default pick.
+    instance_type = gcp_catalog.get_default_instance_type(
+        resources.cpus, resources.memory)
+    if instance_type is None:
+        raise exceptions.ResourcesUnavailableError(
+            f'No instance type satisfies cpus={resources.cpus} '
+            f'memory={resources.memory}.')
+    return gcp_catalog.get_vm_hourly_cost(instance_type,
+                                          use_spot=resources.use_spot)
+
+
+def list_offerings(
+        resources: 'resources_lib.Resources') -> List[TpuOffering]:
+    """Concrete (region, zone, price) placements for a TPU request,
+    cheapest first, honoring any region/zone pin."""
+    if not resources.is_tpu:
+        raise exceptions.InvalidResourcesError(
+            'list_offerings is TPU-only; VM placement is region-flat.')
+    tpu = resources.tpu
+    assert tpu is not None
+    return gcp_catalog.list_tpu_offerings(tpu.name,
+                                          region=resources.region,
+                                          zone=resources.zone,
+                                          use_spot=resources.use_spot)
+
+
+def get_regions(resources: 'resources_lib.Resources') -> List[str]:
+    if resources.cloud == 'local':
+        return ['local']
+    if resources.is_tpu:
+        assert resources.tpu is not None
+        regions = gcp_catalog.tpu_regions(resources.tpu.name)
+    else:
+        regions = sorted({o.region for offs in
+                          gcp_catalog.list_accelerators().values()
+                          for o in offs})
+    if resources.region is not None:
+        regions = [r for r in regions if r == resources.region]
+    return regions
+
+
+def get_zones(resources: 'resources_lib.Resources',
+              region: Optional[str] = None) -> List[str]:
+    if resources.cloud == 'local':
+        return ['local']
+    if resources.is_tpu:
+        assert resources.tpu is not None
+        zones = gcp_catalog.tpu_zones(resources.tpu.name,
+                                      region or resources.region)
+    else:
+        zones = []
+    if resources.zone is not None:
+        zones = [z for z in zones if z == resources.zone]
+    return zones
+
+
+def list_accelerators(
+        name_filter: Optional[str] = None) -> Dict[str, List[TpuOffering]]:
+    return gcp_catalog.list_accelerators(name_filter)
+
+
+def get_default_instance_type(cpus: Optional[str] = None,
+                              memory: Optional[str] = None) -> Optional[str]:
+    return gcp_catalog.get_default_instance_type(cpus, memory)

@@ -1,0 +1,85 @@
+"""Catalog loading infrastructure (capability parity: sky/catalog/common.py).
+
+The reference lazily downloads hosted CSVs with staleness-based refresh
+(sky/catalog/common.py:165 `read_catalog`, URL at :211) into `LazyDataFrame`s
+(:124).  Here catalogs ship *bundled* with the package (TPU SKUs have no good
+public pricing API — examples/tpu/v6e/README.md:7 in the reference notes v6e
+prices missing entirely), and a user-local override directory
+(`~/.skytpu/catalogs/<schema>/`) takes precedence so `data_fetchers` can
+refresh them out-of-band without a package upgrade.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, Optional
+
+import pandas as pd
+
+CATALOG_SCHEMA_VERSION = 'v1'
+_BUNDLED_DIR = os.path.join(os.path.dirname(__file__), 'data')
+
+
+def catalog_override_dir() -> str:
+    return os.path.expanduser(
+        os.environ.get(
+            'SKYTPU_CATALOG_DIR',
+            os.path.join('~/.skytpu/catalogs', CATALOG_SCHEMA_VERSION)))
+
+
+def resolve_catalog_path(filename: str) -> str:
+    """User-refreshed catalog wins over the bundled one."""
+    override = os.path.join(catalog_override_dir(), filename)
+    if os.path.exists(override):
+        return override
+    return os.path.join(_BUNDLED_DIR, filename)
+
+
+class LazyDataFrame:
+    """Thread-safe lazy CSV load (analog of reference LazyDataFrame,
+    sky/catalog/common.py:124).  Re-resolves the path on each cold load so a
+    refreshed user catalog is picked up after `invalidate()`."""
+
+    def __init__(self, filename: str,
+                 postprocess: Optional[Callable[[pd.DataFrame],
+                                                pd.DataFrame]] = None):
+        self._filename = filename
+        self._postprocess = postprocess
+        self._df: Optional[pd.DataFrame] = None
+        self._lock = threading.Lock()
+
+    def read(self) -> pd.DataFrame:
+        if self._df is None:
+            with self._lock:
+                if self._df is None:
+                    df = pd.read_csv(resolve_catalog_path(self._filename))
+                    if self._postprocess is not None:
+                        df = self._postprocess(df)
+                    self._df = df
+        return self._df
+
+    def invalidate(self) -> None:
+        with self._lock:
+            self._df = None
+
+
+def parse_cpus_filter(df: pd.DataFrame, cpus: Optional[str],
+                      col: str = 'vcpus') -> pd.DataFrame:
+    """Filter rows by a '4' (exact) or '4+' (at least) spec
+    (reference: sky/catalog/common.py:419 `_filter_with_cpus`)."""
+    if cpus is None:
+        return df
+    spec = str(cpus).strip()
+    if spec.endswith('+'):
+        return df[df[col] >= float(spec[:-1])]
+    return df[df[col] == float(spec)]
+
+
+def parse_memory_filter(df: pd.DataFrame, memory: Optional[str],
+                        col: str = 'memory_gb') -> pd.DataFrame:
+    if memory is None:
+        return df
+    spec = str(memory).strip()
+    if spec.endswith('+'):
+        return df[df[col] >= float(spec[:-1])]
+    return df[df[col] == float(spec)]

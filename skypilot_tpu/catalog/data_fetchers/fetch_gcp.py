@@ -1,0 +1,88 @@
+"""Regenerate the GCP TPU/VM catalogs from the Cloud Billing API.
+
+Analog of the reference's `sky/catalog/data_fetchers/fetch_gcp.py` (which
+builds TPU price tables from the billing SKU list).  Writes refreshed CSVs to
+`~/.skytpu/catalogs/<schema>/`, which `catalog.common.resolve_catalog_path`
+prefers over the bundled copies.  Requires network + GCP credentials, so it is
+an offline tool, never called on the hot path.
+
+Usage: python -m skypilot_tpu.catalog.data_fetchers.fetch_gcp
+"""
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import Dict, List
+
+from skypilot_tpu.catalog import common
+
+_BILLING_SERVICE_GCE = 'services/6F81-5844-456A'  # Compute Engine SKUs
+_TPU_SKU_RE = re.compile(r'Tpu[- ]?(v\d+[a-z]*)', re.IGNORECASE)
+
+
+def fetch_tpu_prices() -> List[Dict[str, object]]:
+    try:
+        import googleapiclient.discovery  # type: ignore
+    except ImportError as e:
+        raise SystemExit(
+            'google-api-python-client is required to refresh catalogs; '
+            'the bundled catalog remains in use.') from e
+    billing = googleapiclient.discovery.build('cloudbilling', 'v1')
+    rows: List[Dict[str, object]] = []
+    req = billing.services().skus().list(parent=_BILLING_SERVICE_GCE)
+    while req is not None:
+        resp = req.execute()
+        for sku in resp.get('skus', []):
+            m = _TPU_SKU_RE.search(sku.get('description', ''))
+            if not m:
+                continue
+            gen = m.group(1).lower()
+            spot = 'preemptible' in sku.get('description', '').lower()
+            for region in sku.get('serviceRegions', []):
+                pricing = sku.get('pricingInfo', [])
+                if not pricing:
+                    continue
+                expr = pricing[0]['pricingExpression']
+                rate = expr['tieredRates'][-1]['unitPrice']
+                price = (float(rate.get('units', 0)) +
+                         rate.get('nanos', 0) / 1e9)
+                rows.append({
+                    'generation': gen,
+                    'region': region,
+                    'spot': spot,
+                    'price_chip_hr': price,
+                })
+        req = billing.services().skus().list_next(req, resp)
+    return rows
+
+
+def main() -> int:
+    out_dir = common.catalog_override_dir()
+    os.makedirs(out_dir, exist_ok=True)
+    rows = fetch_tpu_prices()
+    if not rows:
+        print('No TPU SKUs returned; keeping bundled catalog.',
+              file=sys.stderr)
+        return 1
+    # Merge on-demand + spot rows into the bundled-catalog schema.
+    merged: Dict[tuple, Dict[str, float]] = {}
+    for r in rows:
+        key = (r['generation'], r['region'])
+        slot = 'spot_price_chip_hr' if r['spot'] else 'price_chip_hr'
+        merged.setdefault(key, {})[slot] = float(r['price_chip_hr'])
+    path = os.path.join(out_dir, 'gcp_tpus.csv')
+    with open(path, 'w', encoding='utf-8') as f:
+        f.write('generation,region,zone,price_chip_hr,spot_price_chip_hr\n')
+        for (gen, region), prices in sorted(merged.items()):
+            od = prices.get('price_chip_hr')
+            sp = prices.get('spot_price_chip_hr', (od or 0) * 0.5)
+            if od is None:
+                continue
+            f.write(f'{gen},{region},{region}-a,{od},{sp}\n')
+    print(f'Wrote {path}')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,157 @@
+"""GCP catalog — TPU slices are the primary SKU (parity: sky/catalog/gcp_catalog.py).
+
+The reference splits TPUs out of a GPU-shaped CSV (gcp_catalog.py:499-556) and
+fakes a `TPU-VM` instance type (:255-277).  Here the TPU table is native:
+per-chip-hour prices by generation x zone; the slice price is
+`chips * price_chip_hr` and host VMs are included in the slice price (true of
+the TPU-VM API — there is no separate host SKU).  VM instance types exist only
+for controllers (jobs/serve) and CPU-only tasks.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import pandas as pd
+
+from skypilot_tpu import accelerators as acc_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu.catalog import common
+
+_tpu_df = common.LazyDataFrame('gcp_tpus.csv')
+_vm_df = common.LazyDataFrame('gcp_vms.csv')
+
+
+@dataclasses.dataclass(frozen=True)
+class TpuOffering:
+    """One purchasable TPU slice placement."""
+    accelerator: str          # canonical, e.g. 'tpu-v5p-128'
+    region: str
+    zone: str
+    hourly_cost: float        # whole slice, on-demand
+    hourly_cost_spot: float   # whole slice, spot
+
+
+def _tpu_rows(generation: str,
+              region: Optional[str] = None,
+              zone: Optional[str] = None) -> pd.DataFrame:
+    df = _tpu_df.read()
+    df = df[df['generation'] == generation]
+    if region is not None:
+        df = df[df['region'] == region]
+    if zone is not None:
+        df = df[df['zone'] == zone]
+    return df
+
+
+def list_tpu_offerings(accelerator: str,
+                       region: Optional[str] = None,
+                       zone: Optional[str] = None,
+                       use_spot: bool = False) -> List[TpuOffering]:
+    """All zones selling this slice, cheapest first."""
+    tpu = acc_lib.parse_tpu(accelerator)
+    rows = _tpu_rows(tpu.generation, region, zone)
+    out = []
+    for _, r in rows.iterrows():
+        out.append(
+            TpuOffering(
+                accelerator=tpu.name,
+                region=r['region'],
+                zone=r['zone'],
+                hourly_cost=float(r['price_chip_hr']) * tpu.num_chips,
+                hourly_cost_spot=(float(r['spot_price_chip_hr']) *
+                                  tpu.num_chips),
+            ))
+    out.sort(key=lambda o: o.hourly_cost_spot if use_spot else o.hourly_cost)
+    return out
+
+
+def get_tpu_hourly_cost(accelerator: str,
+                        region: Optional[str] = None,
+                        zone: Optional[str] = None,
+                        use_spot: bool = False) -> float:
+    offerings = list_tpu_offerings(accelerator, region, zone, use_spot)
+    if not offerings:
+        where = zone or region or 'any region'
+        raise exceptions.ResourcesUnavailableError(
+            f'{accelerator} is not offered in {where}.')
+    best = offerings[0]
+    return best.hourly_cost_spot if use_spot else best.hourly_cost
+
+
+def tpu_regions(accelerator: str) -> List[str]:
+    tpu = acc_lib.parse_tpu(accelerator)
+    return sorted(_tpu_rows(tpu.generation)['region'].unique())
+
+
+def tpu_zones(accelerator: str, region: Optional[str] = None) -> List[str]:
+    tpu = acc_lib.parse_tpu(accelerator)
+    return sorted(_tpu_rows(tpu.generation, region)['zone'].unique())
+
+
+# ----- VM instance types (controllers / CPU tasks) ---------------------------
+def get_vm_spec(instance_type: str) -> Tuple[float, float]:
+    """(vcpus, memory_gb) of an instance type."""
+    df = _vm_df.read()
+    rows = df[df['instance_type'] == instance_type]
+    if rows.empty:
+        raise exceptions.InvalidResourcesError(
+            f'Unknown GCP instance type: {instance_type!r}')
+    r = rows.iloc[0]
+    return float(r['vcpus']), float(r['memory_gb'])
+
+
+def get_vm_hourly_cost(instance_type: str, use_spot: bool = False) -> float:
+    df = _vm_df.read()
+    rows = df[df['instance_type'] == instance_type]
+    if rows.empty:
+        raise exceptions.InvalidResourcesError(
+            f'Unknown GCP instance type: {instance_type!r}')
+    r = rows.iloc[0]
+    return float(r['spot_price_hr'] if use_spot else r['price_hr'])
+
+
+def get_default_instance_type(cpus: Optional[str] = None,
+                              memory: Optional[str] = None) -> Optional[str]:
+    """Cheapest instance type satisfying the cpu/mem spec
+    (reference: per-cloud get_default_instance_type)."""
+    df = _vm_df.read()
+    if cpus is None and memory is None:
+        cpus = '4+'   # controller-friendly default
+    df = common.parse_cpus_filter(df, cpus)
+    df = common.parse_memory_filter(df, memory)
+    if df.empty:
+        return None
+    return df.sort_values('price_hr').iloc[0]['instance_type']
+
+
+def validate_region_zone(
+        region: Optional[str],
+        zone: Optional[str]) -> None:
+    """Region/zone must exist somewhere in the catalog."""
+    df = _tpu_df.read()
+    vm_ok = True  # VM table is region-less (flat pricing)
+    if region is not None and region not in set(df['region']) and not vm_ok:
+        raise exceptions.InvalidInfraError(f'Unknown GCP region {region!r}')
+    if zone is not None:
+        if region is not None and not zone.startswith(region):
+            raise exceptions.InvalidInfraError(
+                f'Zone {zone!r} is not in region {region!r}')
+
+
+def list_accelerators(
+        name_filter: Optional[str] = None) -> Dict[str, List[TpuOffering]]:
+    """Catalog dump for `accelerators list`: canonical name → offerings."""
+    out: Dict[str, List[TpuOffering]] = {}
+    for name in acc_lib.list_tpu_types():
+        if name_filter and name_filter.lower() not in name.lower():
+            continue
+        offerings = list_tpu_offerings(name)
+        if offerings:
+            out[name] = offerings
+    return out
+
+
+def invalidate_cache() -> None:
+    _tpu_df.invalidate()
+    _vm_df.invalidate()

@@ -39,9 +39,14 @@ class AutostopConfig:
         if isinstance(config, int):
             return cls(enabled=True, idle_minutes=config)
         if isinstance(config, dict):
+            unknown = set(config) - {'idle_minutes', 'down', 'wait_for_jobs'}
+            if unknown:
+                raise exceptions.InvalidResourcesError(
+                    f'Unknown autostop fields: {sorted(unknown)}')
             return cls(enabled=True,
                        idle_minutes=int(config.get('idle_minutes', 5)),
-                       down=bool(config.get('down', False)))
+                       down=bool(config.get('down', False)),
+                       wait_for_jobs=bool(config.get('wait_for_jobs', True)))
         raise exceptions.InvalidResourcesError(
             f'Invalid autostop config: {config!r}')
 
@@ -74,6 +79,11 @@ def _parse_accelerators(
         out: Dict[str, int] = {}
         for name, cnt in value.items():
             if acc_lib.is_tpu(name):
+                if int(cnt) != 1:
+                    raise exceptions.InvalidResourcesError(
+                        f'TPU slices have count 1 (the slice is the unit); '
+                        f'got {name}: {cnt}. Request a larger slice '
+                        f'(e.g. a bigger -N suffix) instead.')
                 out[acc_lib.parse_tpu(name).name] = 1
             else:
                 out[acc_lib.canonicalize(name)] = int(cnt)
@@ -142,7 +152,12 @@ class Resources:
             name = next(iter(self.accelerators))
             if acc_lib.is_tpu(name):
                 tpu = acc_lib.parse_tpu(name)
-                dims = [int(d) for d in self.topology.lower().split('x')]
+                try:
+                    dims = [int(d) for d in self.topology.lower().split('x')]
+                except ValueError:
+                    raise exceptions.InvalidResourcesError(
+                        f'Invalid topology {self.topology!r}: expected '
+                        f"'AxB' or 'AxBxC' of integers.") from None
                 prod = 1
                 for d in dims:
                     prod *= d
@@ -258,9 +273,12 @@ class Resources:
         if infra:
             out['infra'] = infra
         if self.accelerators:
-            name, cnt = self.accelerator_name, self.accelerator_count
-            out['accelerators'] = name if (self.is_tpu or
-                                           cnt == 1) else f'{name}:{cnt}'
+            if len(self.accelerators) > 1:
+                out['accelerators'] = dict(self.accelerators)
+            else:
+                name, cnt = self.accelerator_name, self.accelerator_count
+                out['accelerators'] = name if (self.is_tpu or
+                                               cnt == 1) else f'{name}:{cnt}'
         for field, val, default in (
             ('cpus', self.cpus, None), ('memory', self.memory, None),
             ('instance_type', self.instance_type, None),

@@ -189,9 +189,16 @@ class Task:
         out: Dict[str, Any] = {}
         if self.name:
             out['name'] = self.name
-        res = (self.best_resources or self.any_resources).to_yaml_config()
-        if res:
-            out['resources'] = res
+        if self.best_resources is not None or len(self.resources) == 1:
+            res = (self.best_resources or self.any_resources).to_yaml_config()
+            if res:
+                out['resources'] = res
+        else:
+            # Preserve every any_of alternative across the round-trip
+            # (controller handoff/resume must keep failover choices).
+            alts = sorted((r.to_yaml_config() for r in self.resources),
+                          key=lambda c: sorted(c.items(), key=str))
+            out['resources'] = {'any_of': alts}
         if self.num_nodes != 1:
             out['num_nodes'] = self.num_nodes
         if self.workdir:
@@ -235,13 +242,14 @@ def _merge_resources(base: resources_lib.Resources,
                      override_config: Dict[str, Any]) -> resources_lib.Resources:
     """Apply an `any_of:` alternative on top of the base resources config."""
     parsed = resources_lib.Resources.from_yaml_config(override_config)
+    field_names = {f.name for f in dataclasses.fields(parsed)}
     overrides = {
         field: getattr(parsed, field)
         for field in override_config
-        if field in {f.name for f in dataclasses.fields(parsed)}
+        if field in field_names
     }
-    if 'infra' in override_config:
-        overrides['infra'] = parsed.infra
-    if 'accelerators' in override_config:
-        overrides['accelerators'] = parsed.accelerators
+    # 'accelerator_args' maps into runtime_version during parsing; it is not
+    # a dataclass field, so carry it over explicitly.
+    if 'accelerator_args' in override_config:
+        overrides['runtime_version'] = parsed.runtime_version
     return base.copy(**overrides)

@@ -1,0 +1,108 @@
+"""Catalog tests (model: reference tests/unit_tests/test_catalog.py)."""
+import pytest
+
+from skypilot_tpu import catalog
+from skypilot_tpu import exceptions
+from skypilot_tpu.catalog import gcp_catalog
+from skypilot_tpu.resources import Resources
+
+
+def test_tpu_offering_price_scales_with_chips():
+    v5p_8 = gcp_catalog.get_tpu_hourly_cost('tpu-v5p-8')
+    v5p_128 = gcp_catalog.get_tpu_hourly_cost('tpu-v5p-128')
+    # v5p suffix counts cores: 8 cores = 4 chips, 128 cores = 64 chips.
+    assert v5p_8 == pytest.approx(4 * 4.2)
+    assert v5p_128 == pytest.approx(64 * 4.2)
+
+
+def test_spot_cheaper_than_on_demand():
+    for acc in ('tpu-v6e-8', 'tpu-v5litepod-16', 'tpu-v4-32'):
+        od = gcp_catalog.get_tpu_hourly_cost(acc, use_spot=False)
+        spot = gcp_catalog.get_tpu_hourly_cost(acc, use_spot=True)
+        assert spot < od
+
+
+def test_region_pinning_filters_offerings():
+    offs = gcp_catalog.list_tpu_offerings('tpu-v6e-8', region='us-east1')
+    assert offs and all(o.region == 'us-east1' for o in offs)
+    assert gcp_catalog.list_tpu_offerings('tpu-v4-8',
+                                          region='europe-west4') == []
+
+
+def test_unavailable_region_raises():
+    with pytest.raises(exceptions.ResourcesUnavailableError):
+        gcp_catalog.get_tpu_hourly_cost('tpu-v4-8', region='europe-west4')
+
+
+def test_cheapest_first_ordering():
+    offs = gcp_catalog.list_tpu_offerings('tpu-v6e-8')
+    costs = [o.hourly_cost for o in offs]
+    assert costs == sorted(costs)
+
+
+def test_resources_facade_tpu_cost():
+    r = Resources.from_yaml_config({'accelerators': 'tpu-v6e-8'})
+    assert catalog.get_hourly_cost(r) == pytest.approx(8 * 2.7)
+    r_spot = r.copy(use_spot=True)
+    assert catalog.get_hourly_cost(r_spot) == pytest.approx(8 * 1.35)
+
+
+def test_resources_get_cost_seconds():
+    r = Resources.from_yaml_config({'accelerators': 'tpu-v6e-8'})
+    assert r.get_cost(3600) == pytest.approx(8 * 2.7)
+
+
+def test_local_cloud_is_free():
+    r = Resources.from_yaml_config({'infra': 'local'})
+    assert catalog.get_hourly_cost(r) == 0.0
+
+
+def test_default_instance_type():
+    t = catalog.get_default_instance_type(cpus='4+')
+    assert t is not None
+    vcpus, _ = gcp_catalog.get_vm_spec(t)
+    assert vcpus >= 4
+    # Exact spec
+    t = catalog.get_default_instance_type(cpus='8', memory='64')
+    assert t == 'n2-highmem-8'
+
+
+def test_cpu_only_cost_uses_default_instance():
+    r = Resources.from_yaml_config({'cpus': '4+'})
+    assert catalog.get_hourly_cost(r) > 0
+
+
+def test_gpu_request_rejected_tpu_first():
+    r = Resources.from_yaml_config({'accelerators': 'A100:8'})
+    with pytest.raises(exceptions.ResourcesUnavailableError):
+        catalog.get_hourly_cost(r)
+
+
+def test_list_accelerators_filter():
+    accs = catalog.list_accelerators(name_filter='v5p')
+    assert accs and all('v5p' in name for name in accs)
+    for offs in accs.values():
+        assert offs
+
+
+def test_catalog_override_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv('SKYTPU_CATALOG_DIR', str(tmp_path))
+    (tmp_path / 'gcp_tpus.csv').write_text(
+        'generation,region,zone,price_chip_hr,spot_price_chip_hr\n'
+        'v6e,mars-east1,mars-east1-a,0.01,0.005\n')
+    gcp_catalog.invalidate_cache()
+    try:
+        offs = gcp_catalog.list_tpu_offerings('tpu-v6e-8')
+        assert [o.region for o in offs] == ['mars-east1']
+        assert offs[0].hourly_cost == pytest.approx(0.08)
+    finally:
+        monkeypatch.delenv('SKYTPU_CATALOG_DIR')
+        gcp_catalog.invalidate_cache()
+
+
+def test_regions_and_zones_facade():
+    r = Resources.from_yaml_config({'accelerators': 'tpu-v5p-8'})
+    regions = catalog.get_regions(r)
+    assert 'us-east5' in regions
+    zones = catalog.get_zones(r, region='us-east5')
+    assert zones == ['us-east5-a']
