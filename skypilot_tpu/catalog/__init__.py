@@ -75,9 +75,7 @@ def get_regions(resources: 'resources_lib.Resources') -> List[str]:
         assert resources.tpu is not None
         regions = gcp_catalog.tpu_regions(resources.tpu.name)
     else:
-        regions = sorted({o.region for offs in
-                          gcp_catalog.list_accelerators().values()
-                          for o in offs})
+        regions = gcp_catalog.all_regions()
     if resources.region is not None:
         regions = [r for r in regions if r == resources.region]
     return regions

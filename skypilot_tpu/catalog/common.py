@@ -49,14 +49,16 @@ class LazyDataFrame:
         self._lock = threading.Lock()
 
     def read(self) -> pd.DataFrame:
-        if self._df is None:
+        df = self._df
+        if df is None:
             with self._lock:
-                if self._df is None:
+                df = self._df
+                if df is None:
                     df = pd.read_csv(resolve_catalog_path(self._filename))
                     if self._postprocess is not None:
                         df = self._postprocess(df)
                     self._df = df
-        return self._df
+        return df
 
     def invalidate(self) -> None:
         with self._lock:
@@ -77,9 +79,4 @@ def parse_cpus_filter(df: pd.DataFrame, cpus: Optional[str],
 
 def parse_memory_filter(df: pd.DataFrame, memory: Optional[str],
                         col: str = 'memory_gb') -> pd.DataFrame:
-    if memory is None:
-        return df
-    spec = str(memory).strip()
-    if spec.endswith('+'):
-        return df[df[col] >= float(spec[:-1])]
-    return df[df[col] == float(spec)]
+    return parse_cpus_filter(df, memory, col)

@@ -65,10 +65,24 @@ def main() -> int:
         print('No TPU SKUs returned; keeping bundled catalog.',
               file=sys.stderr)
         return 1
-    # Merge on-demand + spot rows into the bundled-catalog schema.
+    # Merge on-demand + spot rows into the bundled-catalog schema.  SKU
+    # descriptions use marketing names ('v5e'); canonicalize through the
+    # accelerator registry so gcp_catalog's generation filter matches.
+    from skypilot_tpu import accelerators as acc_lib
+    import pandas as pd
+    alias_to_gen = {a: g.name for g in acc_lib.GENERATIONS.values()
+                    for a in g.aliases}
+    bundled = pd.read_csv(
+        os.path.join(os.path.dirname(common._BUNDLED_DIR), 'data',
+                     'gcp_tpus.csv'))
+    known_zones = {(r['generation'], r['region']): r['zone']
+                   for _, r in bundled.iterrows()}
     merged: Dict[tuple, Dict[str, float]] = {}
     for r in rows:
-        key = (r['generation'], r['region'])
+        gen = alias_to_gen.get(str(r['generation']).lower())
+        if gen is None:
+            continue
+        key = (gen, r['region'])
         slot = 'spot_price_chip_hr' if r['spot'] else 'price_chip_hr'
         merged.setdefault(key, {})[slot] = float(r['price_chip_hr'])
     path = os.path.join(out_dir, 'gcp_tpus.csv')
@@ -79,7 +93,13 @@ def main() -> int:
             sp = prices.get('spot_price_chip_hr', (od or 0) * 0.5)
             if od is None:
                 continue
-            f.write(f'{gen},{region},{region}-a,{od},{sp}\n')
+            # Billing SKUs are per-region; zones come from the bundled
+            # table (the TPU locations API is the authority — regions
+            # without a known zone are skipped rather than invented).
+            zone = known_zones.get((gen, region))
+            if zone is None:
+                continue
+            f.write(f'{gen},{region},{zone},{od},{sp}\n')
     print(f'Wrote {path}')
     return 0
 

@@ -84,6 +84,11 @@ def tpu_regions(accelerator: str) -> List[str]:
     return sorted(_tpu_rows(tpu.generation)['region'].unique())
 
 
+def all_regions() -> List[str]:
+    """Every region in the TPU catalog (VM placement is region-flat)."""
+    return sorted(_tpu_df.read()['region'].unique())
+
+
 def tpu_zones(accelerator: str, region: Optional[str] = None) -> List[str]:
     tpu = acc_lib.parse_tpu(accelerator)
     return sorted(_tpu_rows(tpu.generation, region)['zone'].unique())
@@ -128,15 +133,17 @@ def get_default_instance_type(cpus: Optional[str] = None,
 def validate_region_zone(
         region: Optional[str],
         zone: Optional[str]) -> None:
-    """Region/zone must exist somewhere in the catalog."""
+    """Region/zone must exist in the TPU catalog (the VM table is
+    region-flat, so the TPU table is the source of truth for placement)."""
     df = _tpu_df.read()
-    vm_ok = True  # VM table is region-less (flat pricing)
-    if region is not None and region not in set(df['region']) and not vm_ok:
+    if region is not None and region not in set(df['region']):
         raise exceptions.InvalidInfraError(f'Unknown GCP region {region!r}')
     if zone is not None:
         if region is not None and not zone.startswith(region):
             raise exceptions.InvalidInfraError(
                 f'Zone {zone!r} is not in region {region!r}')
+        if zone not in set(df['zone']):
+            raise exceptions.InvalidInfraError(f'Unknown GCP zone {zone!r}')
 
 
 def list_accelerators(

@@ -1,0 +1,47 @@
+"""Cloud registry (parity: sky/utils/registry.py cloud registration)."""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.clouds.cloud import Cloud, CloudCapability
+from skypilot_tpu.clouds.gcp import GCP
+from skypilot_tpu.clouds.local import Local
+
+__all__ = ['Cloud', 'CloudCapability', 'GCP', 'Local', 'get_cloud',
+           'enabled_clouds', 'CLOUD_REGISTRY']
+
+CLOUD_REGISTRY: Dict[str, Cloud] = {
+    GCP.NAME: GCP(),
+    Local.NAME: Local(),
+}
+
+
+def get_cloud(name: str) -> Cloud:
+    cloud = CLOUD_REGISTRY.get(name.lower())
+    if cloud is None:
+        raise exceptions.InvalidInfraError(
+            f'Unknown cloud {name!r}. Known: {sorted(CLOUD_REGISTRY)}')
+    return cloud
+
+
+def enabled_clouds(reload: bool = False) -> List[Cloud]:
+    """Clouds with working credentials (`sky check` analog).  Local always
+    qualifies.  `SKYTPU_ENABLED_CLOUDS=gcp,local` overrides the credential
+    probe — the analog of the reference's `enable_all_clouds` test fixture
+    (tests/common_test_fixtures.py:176)."""
+    del reload
+    import os
+    override = os.environ.get('SKYTPU_ENABLED_CLOUDS')
+    if override is not None:
+        return [get_cloud(n) for n in override.split(',') if n.strip()]
+    out = []
+    for cloud in CLOUD_REGISTRY.values():
+        ok, _ = cloud.check_credentials()
+        if ok:
+            out.append(cloud)
+    return out
+
+
+def cloud_in_iterable(cloud: Cloud, clouds) -> bool:
+    return any(cloud.NAME == c.NAME for c in clouds)

@@ -1,0 +1,98 @@
+"""Abstract Cloud (capability parity: sky/clouds/cloud.py:140).
+
+A Cloud answers three questions for the optimizer/backend:
+feasibility (can it serve a Resources request, and with what concrete
+candidates), cost (hourly + egress), and capability gates
+(`CloudCapability` — the analog of the reference's
+`CloudImplementationFeatures` enum, sky/clouds/cloud.py:33, which gates
+STOP/MULTI_NODE/SPOT/AUTOSTOP per cloud *and per resource*: e.g. a GCP
+multi-host TPU pod cannot STOP even though GCP VMs can).
+"""
+from __future__ import annotations
+
+import enum
+from typing import Dict, List, Optional, TYPE_CHECKING
+
+from skypilot_tpu import exceptions
+
+if TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+
+class CloudCapability(enum.Enum):
+    STOP = 'stop'                      # stop/restart instances keeping disks
+    AUTOSTOP = 'autostop'
+    MULTI_NODE = 'multi_node'          # num_nodes > 1
+    SPOT = 'spot'
+    CUSTOM_DISK_TIER = 'custom_disk_tier'
+    OPEN_PORTS = 'open_ports'
+    STORAGE_MOUNTING = 'storage_mounting'
+    HOST_CONTROLLERS = 'host_controllers'  # can host jobs/serve controllers
+
+
+class Cloud:
+    """Base class; subclasses register via clouds.register."""
+
+    NAME = 'abstract'
+    # Egress $/GB leaving this cloud (coarse; the reference models the same
+    # per-cloud scalar for the optimizer's DAG edge costs).
+    EGRESS_COST_PER_GB = 0.0
+
+    # ----- capabilities ------------------------------------------------------
+    def capabilities(self) -> frozenset:
+        raise NotImplementedError
+
+    def unsupported_features_for(
+            self, resources: 'resources_lib.Resources'
+    ) -> Dict[CloudCapability, str]:
+        """Capability → human reason, for this resource shape specifically."""
+        del resources
+        return {}
+
+    def check_capability(self, capability: CloudCapability,
+                         resources: Optional['resources_lib.Resources'] = None
+                         ) -> None:
+        """Raise NotSupportedError if unsupported (globally or for this
+        resource shape)."""
+        if capability not in self.capabilities():
+            raise exceptions.NotSupportedError(
+                f'{self.NAME} does not support {capability.value}.')
+        if resources is not None:
+            reason = self.unsupported_features_for(resources).get(capability)
+            if reason is not None:
+                raise exceptions.NotSupportedError(
+                    f'{capability.value} not supported: {reason}')
+
+    def supports(self, capability: CloudCapability,
+                 resources: Optional['resources_lib.Resources'] = None
+                 ) -> bool:
+        try:
+            self.check_capability(capability, resources)
+            return True
+        except exceptions.NotSupportedError:
+            return False
+
+    # ----- feasibility -------------------------------------------------------
+    def get_feasible_resources(
+        self, resources: 'resources_lib.Resources'
+    ) -> List['resources_lib.Resources']:
+        """Concrete launchable candidates for a (possibly partial) request,
+        cheapest first (reference: get_feasible_launchable_resources,
+        sky/clouds/cloud.py:435)."""
+        raise NotImplementedError
+
+    # ----- cost --------------------------------------------------------------
+    def hourly_cost(self, resources: 'resources_lib.Resources') -> float:
+        from skypilot_tpu import catalog  # lazy: avoid import cycle
+        return catalog.get_hourly_cost(resources)
+
+    def egress_cost(self, num_gb: float) -> float:
+        return self.EGRESS_COST_PER_GB * max(0.0, num_gb)
+
+    # ----- identity / credentials -------------------------------------------
+    def check_credentials(self) -> tuple:
+        """(ok, reason) — `sky check` analog."""
+        return True, None
+
+    def __repr__(self) -> str:
+        return self.NAME

@@ -1,0 +1,41 @@
+"""Local cloud — subprocess "instances" on this machine.
+
+Dev/test cloud: the analog of the reference's LocalDockerBackend +
+mocked-cloud test fixtures (tests/common_test_fixtures.py:176-218) rolled into
+a first-class cloud, so the whole launch path (optimize → provision →
+bootstrap → gang execute → logs) runs hermetically with no cloud account.
+"""
+from __future__ import annotations
+
+from typing import List, TYPE_CHECKING
+
+from skypilot_tpu.clouds import cloud as cloud_lib
+
+if TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+
+class Local(cloud_lib.Cloud):
+    NAME = 'local'
+    EGRESS_COST_PER_GB = 0.0
+
+    def capabilities(self) -> frozenset:
+        return frozenset({
+            cloud_lib.CloudCapability.MULTI_NODE,
+            cloud_lib.CloudCapability.STOP,
+            cloud_lib.CloudCapability.AUTOSTOP,
+            cloud_lib.CloudCapability.OPEN_PORTS,
+            cloud_lib.CloudCapability.HOST_CONTROLLERS,
+        })
+
+    def get_feasible_resources(
+        self, resources: 'resources_lib.Resources'
+    ) -> List['resources_lib.Resources']:
+        if resources.use_spot:
+            return []  # no spot market on localhost
+        # Any request (even a TPU one, for dry-runs) is "feasible" locally;
+        # region is fixed.
+        return [resources.copy(infra='local/local')]
+
+    def check_credentials(self) -> tuple:
+        return True, None

@@ -53,7 +53,11 @@ class AutostopConfig:
     def to_yaml_config(self) -> Union[bool, Dict[str, Any]]:
         if not self.enabled:
             return False
-        return {'idle_minutes': self.idle_minutes, 'down': self.down}
+        out: Dict[str, Any] = {'idle_minutes': self.idle_minutes,
+                               'down': self.down}
+        if not self.wait_for_jobs:
+            out['wait_for_jobs'] = False
+        return out
 
 
 def _parse_accelerators(

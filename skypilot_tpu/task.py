@@ -197,7 +197,8 @@ class Task:
             # Preserve every any_of alternative across the round-trip
             # (controller handoff/resume must keep failover choices).
             alts = sorted((r.to_yaml_config() for r in self.resources),
-                          key=lambda c: sorted(c.items(), key=str))
+                          key=lambda c: sorted(f'{k}={v}' for k, v in
+                                               c.items()))
             out['resources'] = {'any_of': alts}
         if self.num_nodes != 1:
             out['num_nodes'] = self.num_nodes

@@ -1,0 +1,40 @@
+"""Terminal output helpers (tables, spinners-free status lines).
+
+The reference renders optimizer/status tables via rich; rich is available
+here but kept behind this thin wrapper so library output stays plain when
+stdout is not a TTY (and trivially testable).
+"""
+from __future__ import annotations
+
+import sys
+from typing import List, Optional, Sequence
+
+
+def print_table(header: Sequence[str], rows: List[Sequence[str]],
+                title: Optional[str] = None, file=None) -> None:
+    file = file or sys.stdout
+    if title:
+        print(title, file=file)
+    if not rows:
+        print('  (none)', file=file)
+        return
+    widths = [len(h) for h in header]
+    for row in rows:
+        for i, cell in enumerate(row):
+            widths[i] = max(widths[i], len(str(cell)))
+    fmt = '  '.join(f'{{:<{w}}}' for w in widths)
+    print(fmt.format(*header), file=file)
+    for row in rows:
+        print(fmt.format(*[str(c) for c in row]), file=file)
+
+
+def bold(text: str) -> str:
+    if sys.stdout.isatty():
+        return f'\033[1m{text}\033[0m'
+    return text
+
+
+def dim(text: str) -> str:
+    if sys.stdout.isatty():
+        return f'\033[2m{text}\033[0m'
+    return text

@@ -15,6 +15,13 @@ import pytest  # noqa: E402
 
 
 @pytest.fixture
+def enable_all_clouds(monkeypatch):
+    """All clouds 'enabled' without credential probes (analog of the
+    reference fixture tests/common_test_fixtures.py:176)."""
+    monkeypatch.setenv('SKYTPU_ENABLED_CLOUDS', 'gcp,local')
+
+
+@pytest.fixture
 def tmp_home(tmp_path, monkeypatch):
     """Isolated $HOME so state DBs/config files never touch the real one."""
     home = tmp_path / 'home'

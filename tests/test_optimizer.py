@@ -1,0 +1,145 @@
+"""Optimizer dry-run tests (model: reference tests/test_optimizer_dryruns.py)."""
+import pytest
+
+from skypilot_tpu import clouds
+from skypilot_tpu import exceptions
+from skypilot_tpu.dag import Dag
+from skypilot_tpu.optimizer import (Optimizer, OptimizeTarget,
+                                    fill_in_launchable_resources)
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.task import Task
+
+
+def _mk_task(name, acc=None, **res):
+    t = Task(name, run='echo hi')
+    cfg = dict(res)
+    if acc:
+        cfg['accelerators'] = acc
+    t.set_resources(Resources.from_yaml_config(cfg))
+    return t
+
+
+def _dag_of(*tasks):
+    dag = Dag()
+    prev = None
+    for t in tasks:
+        dag.add(t)
+        if prev is not None:
+            dag.add_edge(prev, t)
+        prev = t
+    return dag
+
+
+def test_fill_in_launchable_tpu(enable_all_clouds):
+    t = _mk_task('train', acc='tpu-v6e-8')
+    cands = fill_in_launchable_resources(t)
+    (request, candidates), = cands.items()
+    assert request.accelerator_name == 'tpu-v6e-8'
+    assert candidates, 'expected at least one candidate'
+    assert all(c.is_launchable() for c in candidates)
+    # cheapest first
+    costs = [clouds.get_cloud(c.cloud).hourly_cost(c) for c in candidates]
+    assert costs == sorted(costs)
+
+
+def test_optimize_picks_cheapest_zone(enable_all_clouds):
+    t = _mk_task('train', acc='tpu-v6e-8', infra='gcp')
+    dag = _dag_of(t)
+    Optimizer.optimize(dag, quiet=True)
+    assert t.best_resources is not None
+    assert t.best_resources.zone is not None
+    # us regions are cheapest for v6e in the bundled catalog
+    assert t.best_resources.region.startswith('us-')
+
+
+def test_optimize_respects_region_pin(enable_all_clouds):
+    t = _mk_task('train', acc='tpu-v6e-8', infra='gcp/europe-west4')
+    Optimizer.optimize(_dag_of(t), quiet=True)
+    assert t.best_resources.region == 'europe-west4'
+
+
+def test_optimize_time_prefers_bigger_slice(enable_all_clouds):
+    t = Task('train', run='x')
+    t.estimated_runtime_s = 7200.0
+    t.set_resources({
+        Resources.from_yaml_config({'accelerators': 'tpu-v5e-8',
+                                    'infra': 'gcp'}),
+        Resources.from_yaml_config({'accelerators': 'tpu-v5e-32',
+                                    'infra': 'gcp'}),
+    })
+    Optimizer.optimize(_dag_of(t), minimize=OptimizeTarget.TIME, quiet=True)
+    assert t.best_resources.accelerator_name == 'tpu-v5litepod-32'
+    Optimizer.optimize(_dag_of(t), minimize=OptimizeTarget.COST, quiet=True)
+    # same per-chip price, ideal scaling -> equal cost; cheapest-first
+    # ordering keeps the smaller absolute-$/hr slice acceptable.
+    assert t.best_resources is not None
+
+
+def test_optimize_blocked_resources_failover(enable_all_clouds):
+    t = _mk_task('train', acc='tpu-v6e-8', infra='gcp')
+    Optimizer.optimize(_dag_of(t), quiet=True)
+    first = t.best_resources
+    blocked = [Resources.from_yaml_config(
+        {'infra': f'gcp/{first.region}/{first.zone}'})]
+    Optimizer.optimize(_dag_of(t), blocked_resources=blocked, quiet=True)
+    assert (t.best_resources.region, t.best_resources.zone) != (
+        first.region, first.zone)
+
+
+def test_optimize_all_blocked_raises(enable_all_clouds):
+    t = _mk_task('train', acc='tpu-v4-8', infra='gcp')
+    blocked = [Resources.from_yaml_config({'infra': 'gcp/us-central2'})]
+    with pytest.raises(exceptions.ResourcesUnavailableError):
+        Optimizer.optimize(_dag_of(t), blocked_resources=blocked, quiet=True)
+
+
+def test_optimize_chain_dag(enable_all_clouds):
+    a = _mk_task('prep', cpus='4+', infra='gcp')
+    b = _mk_task('train', acc='tpu-v5p-8', infra='gcp')
+    c = _mk_task('eval', acc='tpu-v5e-8', infra='gcp')
+    dag = _dag_of(a, b, c)
+    Optimizer.optimize(dag, quiet=True)
+    for t in (a, b, c):
+        assert t.best_resources is not None and t.best_resources.is_launchable()
+
+
+def test_optimize_spot(enable_all_clouds):
+    t = _mk_task('train', acc='tpu-v5p-8', infra='gcp', use_spot=True)
+    Optimizer.optimize(_dag_of(t), quiet=True)
+    assert t.best_resources.use_spot
+
+
+def test_local_cloud_optimize(enable_all_clouds):
+    t = _mk_task('dev')
+    t.set_resources(Resources.from_yaml_config({'infra': 'local'}))
+    Optimizer.optimize(_dag_of(t), quiet=True)
+    assert t.best_resources.cloud == 'local'
+
+
+def test_any_of_cross_generation(enable_all_clouds):
+    t = Task('train', run='x')
+    t.set_resources({
+        Resources.from_yaml_config({'accelerators': 'tpu-v5p-8',
+                                    'infra': 'gcp'}),
+        Resources.from_yaml_config({'accelerators': 'tpu-v6e-4',
+                                    'infra': 'gcp'}),
+    })
+    Optimizer.optimize(_dag_of(t), quiet=True)
+    # v6e-4 (4x2.7=10.8) cheaper than v5p-8 (4x4.2=16.8)
+    assert t.best_resources.accelerator_name == 'tpu-v6e-4'
+
+
+def test_tpu_pod_cannot_stop():
+    gcp = clouds.get_cloud('gcp')
+    pod = Resources.from_yaml_config({'accelerators': 'tpu-v5p-16'})
+    single = Resources.from_yaml_config({'accelerators': 'tpu-v5p-8'})
+    assert not gcp.supports(clouds.CloudCapability.STOP, pod)
+    assert gcp.supports(clouds.CloudCapability.STOP, single)
+    with pytest.raises(exceptions.NotSupportedError):
+        gcp.check_capability(clouds.CloudCapability.STOP, pod)
+
+
+def test_local_no_spot(enable_all_clouds):
+    local = clouds.get_cloud('local')
+    r = Resources.from_yaml_config({'infra': 'local', 'use_spot': True})
+    assert local.get_feasible_resources(r) == []
