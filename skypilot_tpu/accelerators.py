@@ -183,6 +183,11 @@ class TpuType:
         return self.name
 
 
+def alias_to_generation() -> Dict[str, str]:
+    """Accepted alias → canonical generation name (e.g. 'v5e'→'v5litepod')."""
+    return dict(_ALIAS_TO_GEN)
+
+
 def is_tpu(accelerator: Optional[str]) -> bool:
     """True iff the accelerator string names a TPU (analog of
     gcp_utils.is_tpu, sky/clouds/utils/gcp_utils.py:30-50)."""

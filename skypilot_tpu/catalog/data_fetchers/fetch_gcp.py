@@ -13,7 +13,7 @@ from __future__ import annotations
 import os
 import re
 import sys
-from typing import Dict, List
+from typing import Dict, List  # noqa: F401  (List used in main)
 
 from skypilot_tpu.catalog import common
 
@@ -70,13 +70,13 @@ def main() -> int:
     # accelerator registry so gcp_catalog's generation filter matches.
     from skypilot_tpu import accelerators as acc_lib
     import pandas as pd
-    alias_to_gen = {a: g.name for g in acc_lib.GENERATIONS.values()
-                    for a in g.aliases}
+    alias_to_gen = acc_lib.alias_to_generation()
     bundled = pd.read_csv(
-        os.path.join(os.path.dirname(common._BUNDLED_DIR), 'data',
-                     'gcp_tpus.csv'))
-    known_zones = {(r['generation'], r['region']): r['zone']
-                   for _, r in bundled.iterrows()}
+        os.path.join(common._BUNDLED_DIR, 'gcp_tpus.csv'))
+    known_zones: Dict[tuple, List[str]] = {}
+    for _, r in bundled.iterrows():
+        known_zones.setdefault((r['generation'], r['region']),
+                               []).append(r['zone'])
     merged: Dict[tuple, Dict[str, float]] = {}
     for r in rows:
         gen = alias_to_gen.get(str(r['generation']).lower())
@@ -95,11 +95,9 @@ def main() -> int:
                 continue
             # Billing SKUs are per-region; zones come from the bundled
             # table (the TPU locations API is the authority — regions
-            # without a known zone are skipped rather than invented).
-            zone = known_zones.get((gen, region))
-            if zone is None:
-                continue
-            f.write(f'{gen},{region},{zone},{od},{sp}\n')
+            # without known zones are skipped rather than invented).
+            for zone in known_zones.get((gen, region), []):
+                f.write(f'{gen},{region},{zone},{od},{sp}\n')
     print(f'Wrote {path}')
     return 0
 

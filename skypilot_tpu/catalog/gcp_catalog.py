@@ -132,18 +132,22 @@ def get_default_instance_type(cpus: Optional[str] = None,
 
 def validate_region_zone(
         region: Optional[str],
-        zone: Optional[str]) -> None:
-    """Region/zone must exist in the TPU catalog (the VM table is
-    region-flat, so the TPU table is the source of truth for placement)."""
+        zone: Optional[str],
+        for_tpu: bool = True) -> None:
+    """Validate a placement pin.  TPU placements must exist in the TPU
+    catalog; VM-only placements (region-flat pricing) only get the
+    zone-in-region consistency check."""
+    if zone is not None and region is not None and \
+            not zone.startswith(region):
+        raise exceptions.InvalidInfraError(
+            f'Zone {zone!r} is not in region {region!r}')
+    if not for_tpu:
+        return
     df = _tpu_df.read()
     if region is not None and region not in set(df['region']):
         raise exceptions.InvalidInfraError(f'Unknown GCP region {region!r}')
-    if zone is not None:
-        if region is not None and not zone.startswith(region):
-            raise exceptions.InvalidInfraError(
-                f'Zone {zone!r} is not in region {region!r}')
-        if zone not in set(df['zone']):
-            raise exceptions.InvalidInfraError(f'Unknown GCP zone {zone!r}')
+    if zone is not None and zone not in set(df['zone']):
+        raise exceptions.InvalidInfraError(f'Unknown GCP zone {zone!r}')
 
 
 def list_accelerators(

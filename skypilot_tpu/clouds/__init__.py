@@ -25,22 +25,26 @@ def get_cloud(name: str) -> Cloud:
     return cloud
 
 
+_enabled_cache: Optional[List[Cloud]] = None
+
+
 def enabled_clouds(reload: bool = False) -> List[Cloud]:
     """Clouds with working credentials (`sky check` analog).  Local always
     qualifies.  `SKYTPU_ENABLED_CLOUDS=gcp,local` overrides the credential
     probe — the analog of the reference's `enable_all_clouds` test fixture
-    (tests/common_test_fixtures.py:176)."""
-    del reload
+    (tests/common_test_fixtures.py:176).  The probe (subprocess to gcloud)
+    is cached; pass reload=True after credential changes."""
     import os
     override = os.environ.get('SKYTPU_ENABLED_CLOUDS')
     if override is not None:
         return [get_cloud(n) for n in override.split(',') if n.strip()]
-    out = []
-    for cloud in CLOUD_REGISTRY.values():
-        ok, _ = cloud.check_credentials()
-        if ok:
-            out.append(cloud)
-    return out
+    global _enabled_cache
+    if _enabled_cache is None or reload:
+        _enabled_cache = [
+            cloud for cloud in CLOUD_REGISTRY.values()
+            if cloud.check_credentials()[0]
+        ]
+    return list(_enabled_cache)
 
 
 def cloud_in_iterable(cloud: Cloud, clouds) -> bool:

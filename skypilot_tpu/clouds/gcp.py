@@ -46,8 +46,6 @@ class GCP(cloud_lib.Cloud):
     def get_feasible_resources(
         self, resources: 'resources_lib.Resources'
     ) -> List['resources_lib.Resources']:
-        from skypilot_tpu import resources as resources_lib  # noqa: F811
-        del resources_lib
         candidates = []
         if resources.is_tpu:
             for off in catalog.list_offerings(resources):

@@ -33,8 +33,11 @@ class Local(cloud_lib.Cloud):
     ) -> List['resources_lib.Resources']:
         if resources.use_spot:
             return []  # no spot market on localhost
-        # Any request (even a TPU one, for dry-runs) is "feasible" locally;
-        # region is fixed.
+        # Only when explicitly requested: local is $0/hr, so offering it
+        # for unpinned requests would win every COST optimization and
+        # silently run "TPU" jobs as laptop subprocesses.
+        if resources.cloud != self.NAME:
+            return []
         return [resources.copy(infra='local/local')]
 
     def check_credentials(self) -> tuple:

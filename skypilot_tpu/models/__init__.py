@@ -1,0 +1,12 @@
+"""Model zoo: JAX/Flax twins of the workloads the reference drives via
+torch recipes (SURVEY.md §2.15): Llama-family decoders (train + serve),
+ResNet (data-parallel vision), and a small encoder classifier (GLUE-style).
+"""
+from skypilot_tpu.models.encoder import (EncoderClassifier, EncoderConfig,
+                                         ENCODER_CONFIGS)
+from skypilot_tpu.models.llama import (Llama, LlamaConfig, LLAMA_CONFIGS)
+from skypilot_tpu.models.resnet import (ResNet, ResNetConfig, RESNET_CONFIGS)
+
+__all__ = ['EncoderClassifier', 'EncoderConfig', 'ENCODER_CONFIGS',
+           'Llama', 'LlamaConfig', 'LLAMA_CONFIGS',
+           'ResNet', 'ResNetConfig', 'RESNET_CONFIGS']

@@ -1,0 +1,80 @@
+"""Small bidirectional transformer encoder classifier.
+
+Twin of the reference's BERT-tiny GLUE/IMDB recipe
+(examples/huggingface_glue_imdb_app.yaml, BASELINE.json configs) as a
+first-party JAX model: token+position embeddings, pre-norm encoder blocks
+with non-causal attention, mean-pool + linear head.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from skypilot_tpu.ops import attention as attn_lib
+
+
+@dataclasses.dataclass(frozen=True)
+class EncoderConfig:
+    vocab_size: int = 30522
+    dim: int = 128
+    n_layers: int = 2
+    n_heads: int = 2
+    ffn_dim: int = 512
+    max_seq_len: int = 512
+    num_classes: int = 2
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+
+ENCODER_CONFIGS = {
+    'bert-tiny': EncoderConfig(),
+    'bert-mini': EncoderConfig(dim=256, n_layers=4, n_heads=4, ffn_dim=1024),
+    'tiny': EncoderConfig(vocab_size=128, dim=32, n_layers=2, n_heads=2,
+                          ffn_dim=64, max_seq_len=64),
+}
+
+
+class EncoderBlock(nn.Module):
+    cfg: EncoderConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        h = nn.LayerNorm(dtype=cfg.dtype)(x)
+        qkv = nn.DenseGeneral((3, cfg.n_heads, cfg.dim // cfg.n_heads),
+                              axis=-1, dtype=cfg.dtype,
+                              param_dtype=cfg.param_dtype)(h)
+        q, k, v = (qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3))
+        attn = attn_lib.mha_reference(q, k, v, causal=False)
+        attn = attn.transpose(0, 2, 1, 3)
+        x = x + nn.DenseGeneral(cfg.dim, axis=(-2, -1), dtype=cfg.dtype,
+                                param_dtype=cfg.param_dtype)(attn)
+        h = nn.LayerNorm(dtype=cfg.dtype)(x)
+        h = nn.Dense(cfg.ffn_dim, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype)(h)
+        h = nn.gelu(h)
+        return x + nn.Dense(cfg.dim, dtype=cfg.dtype,
+                            param_dtype=cfg.param_dtype)(h)
+
+
+class EncoderClassifier(nn.Module):
+    cfg: EncoderConfig
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.cfg
+        pos = jnp.arange(tokens.shape[1])[None, :]
+        x = (nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype,
+                      param_dtype=cfg.param_dtype)(tokens) +
+             nn.Embed(cfg.max_seq_len, cfg.dim, dtype=cfg.dtype,
+                      param_dtype=cfg.param_dtype)(pos))
+        for _ in range(cfg.n_layers):
+            x = EncoderBlock(cfg)(x)
+        x = nn.LayerNorm(dtype=cfg.dtype)(x)
+        pooled = jnp.mean(x, axis=1)
+        return nn.Dense(cfg.num_classes, dtype=jnp.float32,
+                        param_dtype=cfg.param_dtype)(pooled)

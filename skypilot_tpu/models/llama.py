@@ -1,0 +1,308 @@
+"""Llama-family decoder — the flagship model.
+
+JAX/Flax twin of the torch models the reference fine-tunes/serves through
+recipe YAMLs (llm/llama-3_1-finetuning, examples/tpu/v6e/train-llama3-8b —
+reference drives them via env plumbing; here the model is first-party).
+
+TPU-first design:
+- bf16 compute / f32 params & accumulators (MXU-native);
+- every matmul annotated with *logical* axes (`parallel/sharding.py` maps
+  them to mesh axes; fsdp/tp/sp are rule changes, not model changes);
+- attention dispatches to the Pallas flash kernel on TPU, ring attention
+  when the sequence is context-parallel sharded;
+- rotary embeddings precomputed once, `lax.scan`-friendly static shapes;
+- optional per-block remat (`jax.checkpoint`) to trade FLOPs for HBM.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from skypilot_tpu.ops import attention as attn_lib
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    ffn_dim: int = 14336
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    max_seq_len: int = 8192
+    tie_embeddings: bool = False
+    dtype: Any = jnp.bfloat16          # compute dtype
+    param_dtype: Any = jnp.float32
+    remat: bool = True                 # checkpoint each block
+    attention_impl: str = 'flash'      # 'flash' | 'xla' | 'ring'
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    def flops_per_token(self) -> float:
+        """Approx dense fwd+bwd FLOPs/token (6N + attention term) for MFU."""
+        n_params = self.num_params()
+        attn = 12 * self.n_layers * self.dim * self.max_seq_len
+        return 6 * n_params + attn
+
+    def num_params(self) -> int:
+        d, f = self.dim, self.ffn_dim
+        per_layer = (d * d * 2                       # q, o proj
+                     + 2 * d * (self.n_kv_heads * self.head_dim)  # k, v
+                     + 3 * d * f                     # gate, up, down
+                     + 2 * d)                        # norms
+        embed = self.vocab_size * d * (1 if self.tie_embeddings else 2)
+        return self.n_layers * per_layer + embed + d
+
+
+LLAMA_CONFIGS: Dict[str, LlamaConfig] = {
+    # test-size model: exercises GQA (4 q heads over 2 kv heads)
+    'tiny': LlamaConfig(vocab_size=256, dim=64, n_layers=2, n_heads=4,
+                        n_kv_heads=2, ffn_dim=128, max_seq_len=128,
+                        remat=False, rope_theta=10000.0),
+    'llama3-1b': LlamaConfig(vocab_size=128256, dim=2048, n_layers=16,
+                             n_heads=32, n_kv_heads=8, ffn_dim=8192,
+                             tie_embeddings=True),
+    # single-chip bench model: fits one v5e (16 GB HBM) with Adam in f32
+    'bench-600m': LlamaConfig(vocab_size=32768, dim=1536, n_layers=16,
+                              n_heads=12, n_kv_heads=4, ffn_dim=6144,
+                              max_seq_len=2048),
+    # graft-entry model: modest size so single-chip compile checks are fast
+    'llama-250m': LlamaConfig(vocab_size=32000, dim=1024, n_layers=16,
+                              n_heads=16, n_kv_heads=8, ffn_dim=4096,
+                              max_seq_len=2048, remat=False),
+    'llama3-8b': LlamaConfig(),
+    'llama3-70b': LlamaConfig(dim=8192, n_layers=80, n_heads=64,
+                              n_kv_heads=8, ffn_dim=28672),
+    'llama2-7b': LlamaConfig(vocab_size=32000, dim=4096, n_layers=32,
+                             n_heads=32, n_kv_heads=32, ffn_dim=11008,
+                             rope_theta=10000.0, max_seq_len=4096),
+}
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary position embedding. x: [B, H, S, D], positions: [B, S]."""
+    d = x.shape[-1]
+    freqs = 1.0 / (theta**(jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = positions[:, None, :, None].astype(jnp.float32) * freqs  # B1SF
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], -1)
+    return out.astype(x.dtype)
+
+
+def _constrain_activations(x: jax.Array, mesh: Optional[Mesh],
+                           context_parallel: bool = False) -> jax.Array:
+    """Pin activation shardings.  Without this XLA propagates *param*
+    shardings (embed→fsdp) into activations and emits involuntary-
+    rematerialization repartitions.
+
+    Default: batch over (data, fsdp).  Context-parallel (ring attention):
+    batch over data only, *sequence* over fsdp — the ring rotates K/V shards
+    along that axis.  Constraints are skipped when the dim is not divisible
+    (e.g. tiny eval batches).
+    """
+    if mesh is None:
+        return x
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    d_data = mesh.shape.get('data', 1)
+    d_fsdp = mesh.shape.get('fsdp', 1)
+    if context_parallel:
+        batch_axes = 'data' if x.shape[0] % max(d_data, 1) == 0 else None
+        seq_axis = 'fsdp' if x.shape[1] % max(d_fsdp, 1) == 0 else None
+        spec = P(batch_axes, seq_axis, *([None] * (x.ndim - 2)))
+    else:
+        divisor = max(d_data * d_fsdp, 1)
+        if x.shape[0] % divisor != 0:
+            return x
+        spec = P(('data', 'fsdp'), *([None] * (x.ndim - 1)))
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+class RMSNorm(nn.Module):
+    eps: float
+    dtype: Any
+    param_dtype: Any
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        scale = self.param(
+            'scale', nn.with_logical_partitioning(nn.initializers.ones,
+                                                  ('embed',)),
+            (x.shape[-1],), self.param_dtype)
+        x32 = x.astype(jnp.float32)
+        var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+        out = x32 * jax.lax.rsqrt(var + self.eps)
+        return (out * scale.astype(jnp.float32)).astype(self.dtype)
+
+
+class Attention(nn.Module):
+    cfg: LlamaConfig
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, positions: jax.Array,
+                 decode: bool = False) -> jax.Array:
+        cfg = self.cfg
+        dense = lambda name, heads, logical: nn.DenseGeneral(  # noqa: E731
+            features=(heads, cfg.head_dim), axis=-1, use_bias=False,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), logical),
+            name=name)
+        q = dense('q_proj', cfg.n_heads, ('embed', 'heads', 'kv'))(x)
+        k = dense('k_proj', cfg.n_kv_heads, ('embed', 'heads', 'kv'))(x)
+        v = dense('v_proj', cfg.n_kv_heads, ('embed', 'heads', 'kv'))(x)
+        # [B, S, H, D] -> [B, H, S, D]
+        q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+
+        if decode:
+            k, v, attn_out = self._decode_attend(q, k, v)
+        else:
+            attn_out = self._attend(q, k, v)
+        out = attn_out.transpose(0, 2, 1, 3)  # [B, S, H, D]
+        return nn.DenseGeneral(
+            features=cfg.dim, axis=(-2, -1), use_bias=False,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ('heads', 'kv', 'embed')),
+            name='o_proj')(out)
+
+    def _attend(self, q, k, v):
+        cfg = self.cfg
+        if cfg.attention_impl == 'ring':
+            from skypilot_tpu.parallel import ring_attention as ring
+            assert self.mesh is not None, 'ring attention needs a mesh'
+            return ring.ring_attention(q, k, v, mesh=self.mesh, causal=True)
+        if cfg.attention_impl == 'flash':
+            return attn_lib.flash_attention(q, k, v, True)
+        return attn_lib.mha_reference(q, k, v, causal=True)
+
+    def _decode_attend(self, q, k, v):
+        """Single-step decode with a KV cache (serving path)."""
+        cfg = self.cfg
+        is_init = not self.has_variable('cache', 'k')
+        max_len = cfg.max_seq_len
+        b = q.shape[0]
+        ck = self.variable('cache', 'k', jnp.zeros,
+                           (b, cfg.n_kv_heads, max_len, cfg.head_dim),
+                           cfg.dtype)
+        cv = self.variable('cache', 'v', jnp.zeros,
+                           (b, cfg.n_kv_heads, max_len, cfg.head_dim),
+                           cfg.dtype)
+        idx = self.variable('cache', 'index',
+                            lambda: jnp.zeros((), jnp.int32))
+        if not is_init:
+            cur = idx.value
+            ck.value = jax.lax.dynamic_update_slice(
+                ck.value, k, (0, 0, cur, 0))
+            cv.value = jax.lax.dynamic_update_slice(
+                cv.value, v, (0, 0, cur, 0))
+            idx.value = cur + q.shape[2]
+            k_all, v_all = ck.value, cv.value
+            q_pos = cur + jnp.arange(q.shape[2])[None, :]
+            k_pos = jnp.arange(max_len)[None, :]
+            # mask future cache slots via positions
+            out = attn_lib.mha_reference(
+                q, k_all, v_all, causal=True,
+                segment_positions=jnp.broadcast_to(q_pos, (q.shape[0],) +
+                                                   q_pos.shape[1:]),
+                kv_positions=jnp.broadcast_to(k_pos,
+                                              (q.shape[0], max_len)))
+            return k_all, v_all, out
+        return k, v, attn_lib.mha_reference(q, k, v, causal=True)
+
+
+class MLP(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        dense = lambda name, feat, logical: nn.Dense(  # noqa: E731
+            feat, use_bias=False, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), logical), name=name)
+        gate = dense('gate_proj', cfg.ffn_dim, ('embed', 'mlp'))(x)
+        up = dense('up_proj', cfg.ffn_dim, ('embed', 'mlp'))(x)
+        return dense('down_proj', cfg.dim, ('mlp', 'embed'))(
+            nn.silu(gate) * up)
+
+
+class Block(nn.Module):
+    cfg: LlamaConfig
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, positions: jax.Array,
+                 decode: bool = False) -> jax.Array:
+        cfg = self.cfg
+        cp = cfg.attention_impl == 'ring'
+        x = _constrain_activations(x, self.mesh, cp)
+        x = x + Attention(cfg, self.mesh, name='attn')(
+            RMSNorm(cfg.norm_eps, cfg.dtype, cfg.param_dtype,
+                    name='attn_norm')(x), positions, decode)
+        x = x + MLP(cfg, name='mlp')(
+            RMSNorm(cfg.norm_eps, cfg.dtype, cfg.param_dtype,
+                    name='mlp_norm')(x))
+        return _constrain_activations(x, self.mesh, cp)
+
+
+class Llama(nn.Module):
+    cfg: LlamaConfig
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array,
+                 positions: Optional[jax.Array] = None,
+                 decode: bool = False) -> jax.Array:
+        cfg = self.cfg
+        if positions is None:
+            positions = jnp.broadcast_to(
+                jnp.arange(tokens.shape[1])[None, :], tokens.shape)
+        embed = nn.Embed(
+            cfg.vocab_size, cfg.dim, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=1.0), ('vocab', 'embed')),
+            name='embed')
+        x = embed(tokens)
+        block = Block
+        if cfg.remat and not decode:
+            block = nn.remat(
+                Block, static_argnums=(3,),  # (self, x, positions, decode)
+                policy=jax.checkpoint_policies.nothing_saveable)
+        for i in range(cfg.n_layers):
+            x = block(cfg, self.mesh, name=f'layer_{i}')(
+                x, positions, decode)
+        x = RMSNorm(cfg.norm_eps, cfg.dtype, cfg.param_dtype,
+                    name='final_norm')(x)
+        if cfg.tie_embeddings:
+            logits = embed.attend(x)
+        else:
+            logits = nn.Dense(
+                cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype,
+                kernel_init=nn.with_logical_partitioning(
+                    nn.initializers.lecun_normal(), ('embed', 'vocab')),
+                name='lm_head')(x)
+        return logits.astype(jnp.float32)
+
+
+def init_params(model: Llama, rng: jax.Array, batch: int = 1,
+                seq: Optional[int] = None):
+    cfg = model.cfg
+    seq = seq or min(cfg.max_seq_len, 128)
+    tokens = jnp.zeros((batch, seq), jnp.int32)
+    return model.init(rng, tokens)

@@ -1,0 +1,77 @@
+"""ResNet — data-parallel vision twin of the reference recipe
+(examples/resnet_distributed_torch → JAX ResNet on a TPU mesh,
+BASELINE.json configs).
+
+Conv-heavy models map straight onto the MXU via XLA's conv tiling; the only
+TPU-specific care is NHWC layout (TPU-native) and bf16 compute.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: Tuple[int, ...] = (3, 4, 6, 3)   # ResNet-50
+    num_classes: int = 1000
+    width: int = 64
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+
+RESNET_CONFIGS = {
+    'resnet18': ResNetConfig(stage_sizes=(2, 2, 2, 2)),
+    'resnet50': ResNetConfig(),
+    'tiny': ResNetConfig(stage_sizes=(1, 1), num_classes=10, width=8),
+}
+
+
+class BottleneckBlock(nn.Module):
+    features: int
+    strides: int
+    cfg: ResNetConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cfg = self.cfg
+        conv = lambda f, k, s=1: nn.Conv(  # noqa: E731
+            f, (k, k), (s, s), padding='SAME', use_bias=False,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+        norm = lambda: nn.BatchNorm(  # noqa: E731
+            use_running_average=not train, momentum=0.9, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype)
+        residual = x
+        y = nn.relu(norm()(conv(self.features, 1)(x)))
+        y = nn.relu(norm()(conv(self.features, 3, self.strides)(y)))
+        y = norm()(conv(self.features * 4, 1)(y))
+        if residual.shape != y.shape:
+            residual = norm()(
+                conv(self.features * 4, 1, self.strides)(residual))
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    cfg: ResNetConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cfg = self.cfg
+        x = nn.Conv(cfg.width, (7, 7), (2, 2), padding='SAME',
+                    use_bias=False, dtype=cfg.dtype,
+                    param_dtype=cfg.param_dtype)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         dtype=cfg.dtype, param_dtype=cfg.param_dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), (2, 2), padding='SAME')
+        for i, n_blocks in enumerate(cfg.stage_sizes):
+            for j in range(n_blocks):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = BottleneckBlock(cfg.width * 2**i, strides, cfg)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(cfg.num_classes, dtype=jnp.float32,
+                        param_dtype=cfg.param_dtype)(x)

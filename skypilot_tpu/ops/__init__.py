@@ -1,0 +1,7 @@
+"""TPU-native ops: attention (XLA + Pallas flash), ring attention, fused bits.
+
+The compute path of the framework: models/ call these; XLA fuses the rest.
+"""
+from skypilot_tpu.ops.attention import flash_attention, mha_reference
+
+__all__ = ['flash_attention', 'mha_reference']

@@ -1,0 +1,106 @@
+"""Attention ops with a Pallas TPU fast path.
+
+`mha_reference` is the XLA implementation (always correct, runs anywhere,
+fuses well).  `flash_attention` dispatches to the Pallas online-softmax
+kernel on TPU (`ops/pallas/flash_attention.py`) and falls back to the
+reference elsewhere.  Backward of the Pallas path recomputes attention via
+the XLA implementation (flash-style recompute: O(S) memory, trades FLOPs for
+HBM — the right trade on TPU where attention bwd is bandwidth-bound).
+
+Shapes: q [B, Hq, Sq, D], k/v [B, Hkv, Sk, D]; grouped-query attention is
+expressed by Hq = G * Hkv (query heads grouped over kv heads).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _expand_kv(k: jax.Array, num_q_heads: int) -> jax.Array:
+    """GQA: repeat kv heads to match query heads (XLA turns this into a
+    broadcast; no HBM copy)."""
+    b, h_kv, s, d = k.shape
+    if h_kv == num_q_heads:
+        return k
+    group = num_q_heads // h_kv
+    k = jnp.repeat(k, group, axis=1)
+    return k
+
+
+def mha_reference(q: jax.Array,
+                  k: jax.Array,
+                  v: jax.Array,
+                  causal: bool = True,
+                  scale: Optional[float] = None,
+                  segment_positions: Optional[jax.Array] = None,
+                  kv_positions: Optional[jax.Array] = None) -> jax.Array:
+    """XLA multi-head attention (numerically the ground truth for the
+    Pallas kernel's tests).
+
+    segment_positions/kv_positions: optional absolute positions
+    [B, Sq] / [B, Sk] for causal masking when q/k are *shards* of a longer
+    sequence (ring attention uses this).
+    """
+    orig_dtype = q.dtype
+    scale = scale if scale is not None else q.shape[-1]**-0.5
+    k = _expand_kv(k, q.shape[1])
+    v = _expand_kv(v, q.shape[1])
+    logits = jnp.einsum('bhqd,bhkd->bhqk', q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        if segment_positions is None:
+            q_pos = jnp.arange(q.shape[2])[None, :]
+            k_pos = jnp.arange(k.shape[2])[None, :]
+        else:
+            q_pos = segment_positions
+            k_pos = (kv_positions if kv_positions is not None
+                     else segment_positions)
+        mask = q_pos[:, None, :, None] >= k_pos[:, None, None, :]
+        logits = jnp.where(mask, logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1)
+    # Fully-masked rows (possible for ring-attention shards) produce NaN
+    # from softmax(-inf row); zero them so the combine step can ignore them.
+    probs = jnp.where(jnp.isnan(probs), 0.0, probs)
+    out = jnp.einsum('bhqk,bhkd->bhqd', probs.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(orig_dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention(q: jax.Array,
+                    k: jax.Array,
+                    v: jax.Array,
+                    causal: bool = True,
+                    block_size: int = 512) -> jax.Array:
+    """Flash attention: Pallas kernel on TPU, XLA reference elsewhere."""
+    return _flash_fwd_impl(q, k, v, causal, block_size)
+
+
+def _flash_fwd_impl(q, k, v, causal, block_size):
+    if jax.default_backend() == 'tpu':
+        from skypilot_tpu.ops.pallas import flash_attention as pallas_fa
+        return pallas_fa.flash_attention_fwd(q, k, v, causal=causal,
+                                             block_size=block_size)
+    return mha_reference(q, k, v, causal=causal)
+
+
+def _flash_fwd(q, k, v, causal, block_size):
+    out = _flash_fwd_impl(q, k, v, causal, block_size)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, block_size, residuals, g):
+    del block_size
+    q, k, v = residuals
+    # Flash-style recompute: re-run the XLA forward under vjp.  XLA fuses
+    # this into a bandwidth-friendly bwd; no O(S^2) tensor is materialized
+    # in HBM beyond the recompute tiles.
+    _, vjp_fn = jax.vjp(
+        lambda q_, k_, v_: mha_reference(q_, k_, v_, causal=causal), q, k, v)
+    return vjp_fn(g)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)

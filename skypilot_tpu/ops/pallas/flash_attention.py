@@ -1,0 +1,122 @@
+"""Pallas TPU flash-attention forward kernel (online softmax).
+
+Canonical TPU pattern: 3D grid (batch*heads, q_blocks, k_blocks) with the
+k dimension innermost — Mosaic iterates the last grid axis sequentially on
+the core, so VMEM scratch (running max `m`, denominator `l`, accumulator
+`acc`) persists across k steps of one q block.  Causal blocks strictly above
+the diagonal are skipped with `pl.when` (no MXU work issued).
+
+Sizing: q/k/v blocks live in VMEM ((block, D) each); with block=512 and
+D=128 in bf16 that is ~128 KB per operand — far under the ~16 MB/core VMEM,
+leaving room for the f32 accumulator and double buffering.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+               scale: float, causal: bool, block_q: int, block_k: int):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    n_k = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # Causal: whole block above the diagonal contributes nothing.
+    diag_ok = (not causal) or (kj * block_k <= qi * block_q + block_q - 1)
+
+    @pl.when(diag_ok)
+    def _compute():
+        q = q_ref[0]                                   # (block_q, D)
+        k = k_ref[0]                                   # (block_k, D)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (bq, bk)
+        if causal:
+            q_pos = (qi * block_q +
+                     jax.lax.broadcasted_iota(jnp.int32, s.shape, 0))
+            k_pos = (kj * block_k +
+                     jax.lax.broadcasted_iota(jnp.int32, s.shape, 1))
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_prev = m_scr[:]                              # (bq, 128)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)     # (bq, 1)
+        m_new = jnp.maximum(m_prev, m_cur)             # broadcast → (bq,128)
+        p = jnp.exp(s - m_new[:, :1])                  # (bq, bk)
+        correction = jnp.exp(m_prev[:, :1] - m_new[:, :1])  # (bq, 1)
+        l_scr[:] = l_scr[:] * correction + jnp.sum(
+            p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * correction + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = m_new
+
+    @pl.when(kj == n_k - 1)
+    def _finalize():
+        # Rows with an all-masked history keep l=0; emit 0 instead of NaN.
+        l = l_scr[:, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=('causal', 'block_size', 'interpret'))
+def flash_attention_fwd(q: jax.Array,
+                        k: jax.Array,
+                        v: jax.Array,
+                        causal: bool = True,
+                        block_size: int = 512,
+                        interpret: bool = False) -> jax.Array:
+    """q [B,Hq,S,D], k/v [B,Hkv,S,D] → [B,Hq,S,D].  GQA via head repeat
+    (broadcast, fused by XLA before the kernel)."""
+    b, hq, s, d = q.shape
+    hkv = k.shape[1]
+    if hkv != hq:
+        k = jnp.repeat(k, hq // hkv, axis=1)
+        v = jnp.repeat(v, hq // hkv, axis=1)
+    scale = d**-0.5
+    block_q = min(block_size, s)
+    block_k = min(block_size, s)
+    if s % block_q or s % block_k:
+        raise ValueError(f'seq len {s} must divide block size {block_q}')
+    q3 = q.reshape(b * hq, s, d)
+    k3 = k.reshape(b * hq, s, d)
+    v3 = v.reshape(b * hq, s, d)
+    grid = (b * hq, s // block_q, s // block_k)
+    kernel = functools.partial(_fa_kernel, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, kj: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, kj: (bh, kj, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, kj: (bh, kj, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d),
+                               lambda bh, qi, kj: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * hq, s, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),   # running max m
+            pltpu.VMEM((block_q, 1), jnp.float32),     # denominator l
+            pltpu.VMEM((block_q, d), jnp.float32),     # output accumulator
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=4 * b * hq * s * s * d // (2 if causal else 1),
+            bytes_accessed=(q3.size + k3.size + v3.size) * q.dtype.itemsize,
+            transcendentals=b * hq * s * s,
+        ),
+        interpret=interpret,
+    )(q3, k3, v3)
+    return out.reshape(b, hq, s, d)

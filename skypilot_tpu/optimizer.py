@@ -57,9 +57,25 @@ def _blocked(candidate: resources_lib.Resources,
     return False
 
 
+def _hourly_cost_memo(memo: Optional[dict]):
+    """Candidate→$/hr with memoization (Resources is hashable); the catalog
+    scan behind hourly_cost is pandas-filter-per-call, so one optimize pass
+    should price each candidate exactly once."""
+    memo = memo if memo is not None else {}
+
+    def cost(candidate: resources_lib.Resources) -> float:
+        if candidate not in memo:
+            memo[candidate] = clouds_lib.get_cloud(
+                candidate.cloud).hourly_cost(candidate)
+        return memo[candidate]
+
+    return cost
+
+
 def fill_in_launchable_resources(
     task: task_lib.Task,
     blocked_resources: Optional[List[resources_lib.Resources]] = None,
+    cost_memo: Optional[dict] = None,
 ) -> Dict[resources_lib.Resources, List[resources_lib.Resources]]:
     """Per requested Resources, concrete launchable candidates (cheapest
     first) across enabled clouds (reference: sky/optimizer.py:1319)."""
@@ -85,8 +101,8 @@ def fill_in_launchable_resources(
         candidates = [
             c for c in candidates if not _blocked(c, blocked_resources)
         ]
-        candidates.sort(key=lambda c: clouds_lib.get_cloud(c.cloud)
-                        .hourly_cost(c) * task.num_nodes)
+        cost = _hourly_cost_memo(cost_memo)
+        candidates.sort(key=lambda c: cost(c) * task.num_nodes)
         out[request] = candidates
     return out
 
@@ -158,13 +174,15 @@ class Optimizer:
         blocked_resources: Optional[List[resources_lib.Resources]],
     ) -> List[Tuple[resources_lib.Resources, float, float]]:
         """[(candidate, cost_$, time_s)] for all feasible placements."""
-        per_request = fill_in_launchable_resources(task, blocked_resources)
+        memo: dict = {}
+        per_request = fill_in_launchable_resources(task, blocked_resources,
+                                                   cost_memo=memo)
+        hourly_of = _hourly_cost_memo(memo)
         out = []
         for _, candidates in per_request.items():
             for c in candidates:
                 time_s = _estimate_runtime_s(task, c)
-                hourly = clouds_lib.get_cloud(c.cloud).hourly_cost(c)
-                cost = hourly * task.num_nodes * time_s / 3600.0
+                cost = hourly_of(c) * task.num_nodes * time_s / 3600.0
                 out.append((c, cost, time_s))
         if not out:
             raise exceptions.ResourcesUnavailableError(

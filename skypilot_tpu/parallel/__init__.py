@@ -1,0 +1,18 @@
+"""Parallelism primitives: device meshes, sharding rules, distributed init.
+
+This package is the JAX-native answer to the reference's parallelism story,
+which lives entirely in torch/NCCL recipe YAMLs (SURVEY.md §2.15): here
+DP/FSDP/TP/SP are first-class mesh axes consumed by `models/` and `train/`,
+and multi-host wiring is `jax.distributed.initialize` fed from the env vars
+the gang executor injects (the analog of SKYPILOT_NODE_RANK plumbing,
+reference task_codegen.py:583).
+"""
+from skypilot_tpu.parallel.mesh import (MeshPlan, build_mesh, mesh_axes,
+                                        plan_mesh)
+from skypilot_tpu.parallel.distributed import (distributed_env_from_cluster,
+                                               maybe_initialize_distributed)
+
+__all__ = [
+    'MeshPlan', 'build_mesh', 'mesh_axes', 'plan_mesh',
+    'distributed_env_from_cluster', 'maybe_initialize_distributed',
+]

@@ -1,0 +1,55 @@
+"""Multi-host wiring: jax.distributed from gang-executor env vars.
+
+The gang executor (agent/gang.py) starts one process per slice host and
+injects:
+  SKYTPU_NUM_NODES, SKYTPU_NODE_RANK, SKYTPU_NODE_IPS,
+  SKYTPU_COORDINATOR_ADDR (head host ip:port)
+— the analog of the reference's SKYPILOT_* vars (sky/skylet/constants.py:445)
+— plus libtpu/megascale vars for multislice (MEGASCALE_COORDINATOR_ADDRESS
+etc.).  User code calls `maybe_initialize_distributed()` once; single-process
+runs are a no-op so the same script works on one chip and on a pod.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+ENV_NUM_NODES = 'SKYTPU_NUM_NODES'
+ENV_NODE_RANK = 'SKYTPU_NODE_RANK'
+ENV_NODE_IPS = 'SKYTPU_NODE_IPS'
+ENV_COORDINATOR = 'SKYTPU_COORDINATOR_ADDR'
+DEFAULT_COORDINATOR_PORT = 8476
+
+
+def distributed_env_from_cluster(node_ips: List[str],
+                                 node_rank: int,
+                                 coordinator_port: int =
+                                 DEFAULT_COORDINATOR_PORT) -> Dict[str, str]:
+    """Env block the gang executor injects into every slice-host process."""
+    return {
+        ENV_NUM_NODES: str(len(node_ips)),
+        ENV_NODE_RANK: str(node_rank),
+        ENV_NODE_IPS: '\n'.join(node_ips),
+        ENV_COORDINATOR: f'{node_ips[0]}:{coordinator_port}',
+    }
+
+
+def maybe_initialize_distributed(
+        coordinator_address: Optional[str] = None,
+        num_processes: Optional[int] = None,
+        process_id: Optional[int] = None) -> bool:
+    """Initialize jax.distributed from args or the SKYTPU_* env; no-op for
+    single-process runs.  Returns True iff distributed init happened."""
+    import jax
+    num_processes = num_processes if num_processes is not None else int(
+        os.environ.get(ENV_NUM_NODES, '1'))
+    if num_processes <= 1:
+        return False
+    coordinator_address = coordinator_address or os.environ.get(
+        ENV_COORDINATOR)
+    process_id = process_id if process_id is not None else int(
+        os.environ.get(ENV_NODE_RANK, '0'))
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    return True

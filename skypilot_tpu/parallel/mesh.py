@@ -1,0 +1,98 @@
+"""Device-mesh construction for TPU slices.
+
+Axes (scaling-book recipe: pick a mesh, annotate shardings, let XLA insert
+collectives):
+
+- ``data``    — pure data parallelism (gradient all-reduce over ICI/DCN)
+- ``fsdp``    — data parallelism with fully-sharded params (ZeRO-3 style);
+                also the context-parallel axis for ring attention (sequence
+                shards travel around this axis's ring)
+- ``tensor``  — megatron-style tensor parallelism inside a layer
+
+The TPU ICI torus favors meshes whose fastest-varying axis maps to
+physically adjacent chips; `jax.sharding.Mesh` over `jax.devices()` already
+uses the slice's physical order, so we only choose axis *sizes* here.
+Reference parity: this replaces the reference's env-var plumbing into
+torchrun/NCCL (SURVEY.md §2.15) with an actual mesh object the model and
+train step consume.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh
+
+MESH_AXES = ('data', 'fsdp', 'tensor')
+
+
+def mesh_axes() -> Tuple[str, ...]:
+    return MESH_AXES
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshPlan:
+    """Chosen parallelism degrees; product must equal device count."""
+    data: int = 1
+    fsdp: int = 1
+    tensor: int = 1
+
+    @property
+    def num_devices(self) -> int:
+        return self.data * self.fsdp * self.tensor
+
+    def validate(self, n_devices: int) -> None:
+        if self.num_devices != n_devices:
+            raise ValueError(
+                f'Mesh plan {self} uses {self.num_devices} devices, but '
+                f'{n_devices} are available.')
+
+
+def plan_mesh(n_devices: int,
+              data: Optional[int] = None,
+              fsdp: Optional[int] = None,
+              tensor: Optional[int] = None) -> MeshPlan:
+    """Fill in unset axis sizes.
+
+    Policy (matches common TPU practice): tensor parallelism only when asked
+    (it needs the fastest ICI links); remaining devices default to ``fsdp``,
+    which composes with context parallelism and keeps HBM headroom for large
+    models.  `data` absorbs what the caller pins.
+    """
+    known = {'data': data, 'fsdp': fsdp, 'tensor': tensor}
+    fixed = {k: v for k, v in known.items() if v is not None}
+    prod = math.prod(fixed.values()) if fixed else 1
+    if n_devices % max(prod, 1) != 0:
+        raise ValueError(
+            f'Pinned axes {fixed} do not divide device count {n_devices}.')
+    free = n_devices // max(prod, 1)
+    if 'fsdp' not in fixed:
+        fixed['fsdp'] = fixed.get('fsdp', 1) * free
+        free = 1
+    elif 'data' not in fixed:
+        fixed['data'] = fixed.get('data', 1) * free
+        free = 1
+    if free != 1:
+        # All three axes pinned but don't multiply out — validate() catches.
+        pass
+    plan = MeshPlan(data=fixed.get('data', 1),
+                    fsdp=fixed.get('fsdp', 1),
+                    tensor=fixed.get('tensor', 1))
+    plan.validate(n_devices)
+    return plan
+
+
+def build_mesh(plan: Optional[MeshPlan] = None,
+               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Construct the Mesh.  Device order is `jax.devices()` order, which on a
+    TPU slice follows the physical ICI torus — the last mesh axis varies
+    fastest, so put the most communication-hungry axis (`tensor`) last."""
+    devices = list(devices if devices is not None else jax.devices())
+    if plan is None:
+        plan = plan_mesh(len(devices))
+    plan.validate(len(devices))
+    import numpy as np
+    dev_array = np.array(devices).reshape(plan.data, plan.fsdp, plan.tensor)
+    return Mesh(dev_array, MESH_AXES)

@@ -1,0 +1,7 @@
+"""Training: sharded train-step factory, checkpointing, data pipeline."""
+from skypilot_tpu.train.trainer import (Trainer, TrainConfig,
+                                        make_sharded_train_step,
+                                        make_train_state)
+
+__all__ = ['Trainer', 'TrainConfig', 'make_sharded_train_step',
+           'make_train_state']

@@ -1,0 +1,42 @@
+"""Checkpointing via orbax — the preemption-recovery backbone.
+
+The reference's documented recovery pattern is "checkpoint to a MOUNT
+bucket, reload on recover" (docs/source/examples/managed-jobs.rst:282-289);
+managed jobs here follow the same convention, with orbax doing sharded,
+async-friendly saves that restore onto a *different* mesh shape if the
+recovered slice differs (orbax resharding).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+
+class CheckpointManager:
+    def __init__(self, directory: str, max_to_keep: int = 3) -> None:
+        self.directory = os.path.abspath(os.path.expanduser(directory))
+        os.makedirs(self.directory, exist_ok=True)
+        options = ocp.CheckpointManagerOptions(max_to_keep=max_to_keep,
+                                               create=True)
+        self._mgr = ocp.CheckpointManager(self.directory, options=options)
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def save(self, step: int, state: Any, wait: bool = False) -> None:
+        self._mgr.save(step, args=ocp.args.StandardSave(state))
+        if wait:
+            self._mgr.wait_until_finished()
+
+    def restore(self, step: int, target: Any) -> Any:
+        """Restore into `target`'s structure/shardings (reshard on load)."""
+        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, target)
+        return self._mgr.restore(step,
+                                 args=ocp.args.StandardRestore(abstract))
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()

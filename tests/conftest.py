@@ -5,11 +5,17 @@ the same pjit/shard_map code paths that run on real TPU slices.
 """
 import os
 
-os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+# The axon sitecustomize registers the real-TPU backend at interpreter
+# startup (before pytest imports this file), so env vars alone cannot force
+# CPU; override via jax.config, which wins as long as no backend has been
+# initialized yet.  XLA_FLAGS must still be set before first backend use.
 xla_flags = os.environ.get('XLA_FLAGS', '')
 if '--xla_force_host_platform_device_count' not in xla_flags:
     os.environ['XLA_FLAGS'] = (
         xla_flags + ' --xla_force_host_platform_device_count=8').strip()
+import jax  # noqa: E402
+
+jax.config.update('jax_platforms', 'cpu')
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,73 @@
+"""Attention op tests: pallas kernel (interpret mode) and ring attention
+against the XLA reference. Runs on the 8-device virtual CPU mesh."""
+import jax
+import jax.numpy as jnp
+import pytest
+
+from skypilot_tpu.ops.attention import flash_attention, mha_reference
+from skypilot_tpu.ops.pallas.flash_attention import flash_attention_fwd
+from skypilot_tpu.parallel.mesh import build_mesh, plan_mesh
+from skypilot_tpu.parallel.ring_attention import ring_attention
+
+
+def _qkv(b=2, h=4, s=256, d=64, hkv=None, dtype=jnp.float32, seed=0):
+    key = jax.random.PRNGKey(seed)
+    kq, kk, kv = jax.random.split(key, 3)
+    hkv = hkv or h
+    return (jax.random.normal(kq, (b, h, s, d), dtype),
+            jax.random.normal(kk, (b, hkv, s, d), dtype),
+            jax.random.normal(kv, (b, hkv, s, d), dtype))
+
+
+@pytest.mark.parametrize('causal', [True, False])
+def test_pallas_flash_matches_reference(causal):
+    q, k, v = _qkv()
+    ref = mha_reference(q, k, v, causal=causal)
+    out = flash_attention_fwd(q, k, v, causal=causal, block_size=128,
+                              interpret=True)
+    assert jnp.max(jnp.abs(ref - out)) < 5e-3  # interpret-mode MXU numerics
+
+
+def test_pallas_flash_gqa():
+    q, k, v = _qkv(h=4, hkv=2)
+    ref = mha_reference(q, k, v, causal=True)
+    out = flash_attention_fwd(q, k, v, causal=True, block_size=128,
+                              interpret=True)
+    assert jnp.max(jnp.abs(ref - out)) < 5e-3
+
+
+def test_flash_attention_dispatch_cpu_and_grad():
+    # On CPU the public entry point uses the XLA path; grads flow.
+    q, k, v = _qkv(s=128)
+    out = flash_attention(q, k, v, True)
+    ref = mha_reference(q, k, v, causal=True)
+    assert jnp.allclose(out, ref, atol=1e-5)
+    g = jax.grad(lambda q: flash_attention(q, k, v, True).sum())(q)
+    g_ref = jax.grad(lambda q: mha_reference(q, k, v, causal=True).sum())(q)
+    assert jnp.allclose(g, g_ref, atol=1e-4)
+
+
+@pytest.mark.parametrize('causal', [True, False])
+def test_ring_attention_exact(causal):
+    mesh = build_mesh(plan_mesh(8, data=1, fsdp=8, tensor=1))
+    q, k, v = _qkv(s=512)
+    ref = mha_reference(q, k, v, causal=causal)
+    out = ring_attention(q, k, v, mesh=mesh, causal=causal)
+    assert jnp.max(jnp.abs(ref - out)) < 1e-5
+
+
+def test_ring_attention_gqa_with_tensor_axis():
+    mesh = build_mesh(plan_mesh(8, data=1, fsdp=4, tensor=2))
+    q, k, v = _qkv(h=4, hkv=2, s=256)
+    ref = mha_reference(q, k, v, causal=True)
+    out = ring_attention(q, k, v, mesh=mesh, causal=True)
+    assert jnp.max(jnp.abs(ref - out)) < 1e-5
+
+
+def test_ring_attention_grad():
+    mesh = build_mesh(plan_mesh(8, data=1, fsdp=8, tensor=1))
+    q, k, v = _qkv(s=256)
+    g = jax.grad(
+        lambda q: ring_attention(q, k, v, mesh=mesh, causal=True).sum())(q)
+    g_ref = jax.grad(lambda q: mha_reference(q, k, v, causal=True).sum())(q)
+    assert jnp.max(jnp.abs(g - g_ref)) < 1e-4
