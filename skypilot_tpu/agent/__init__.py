@@ -1,0 +1,10 @@
+"""Head-host agent (skylet equivalent, SURVEY.md §2.9).
+
+Runs on worker-0 of every cluster: sqlite job queue + FIFO scheduler, gang
+executor fanning the job out to all slice hosts with distributed-JAX env
+injected, log capture/tail, autostop bookkeeping — exposed over a local
+HTTP/JSON API that the backend reaches directly (local cloud) or through an
+SSH tunnel (TPU VMs), the same topology as the reference's skylet gRPC
+behind an SSH tunnel (cloud_vm_ray_backend.py:2392).  No Ray: a TPU slice
+is a deterministic worker set, so gang control is plain process supervision.
+"""

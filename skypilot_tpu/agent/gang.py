@@ -1,0 +1,166 @@
+"""Gang executor: run one job on every host of the slice, no Ray.
+
+The reference builds a Ray placement group with one bundle per node and
+launches ray.remote tasks per bundle (task_codegen.py:421,:457); a TPU
+slice needs none of that — the worker set is fixed at provision time, so
+the gang is plain processes: one per host, driven from the head agent over
+SSH (remote hosts) or subprocess (local / head itself).
+
+Env injected per host (parity: SKYPILOT_* vars, task_codegen.py:583 +
+skylet/constants.py:445, extended with the JAX distributed wiring):
+  SKYTPU_NUM_NODES      total host count (the JAX process count)
+  SKYTPU_NODE_RANK      global host rank (JAX process id)
+  SKYTPU_NODE_IPS       newline-separated host ips
+  SKYTPU_COORDINATOR_ADDR  head_ip:8476  (jax.distributed coordinator)
+  SKYTPU_NUM_TPU_CHIPS  chips per host
+so user code just calls skypilot_tpu.parallel.maybe_initialize_distributed().
+
+Failure policy: any host's non-zero exit fails the whole gang (TPU slices
+are all-or-nothing: a dead host wedges the ICI mesh; the managed-jobs layer
+handles recreate-and-resume).
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.parallel import distributed
+from skypilot_tpu.utils import command_runner as runner_lib
+
+
+def build_host_env(host_ips: List[str], host_rank: int,
+                   chips_per_host: int,
+                   extra_env: Optional[Dict[str, str]] = None
+                   ) -> Dict[str, str]:
+    env = distributed.distributed_env_from_cluster(host_ips, host_rank)
+    env['SKYTPU_NUM_TPU_CHIPS'] = str(chips_per_host)
+    if extra_env:
+        env.update(extra_env)
+    return env
+
+
+class GangJob:
+    """One job's gang execution across hosts."""
+
+    def __init__(self, job_id: int, spec: Dict[str, Any],
+                 log_dir: str) -> None:
+        self.job_id = job_id
+        self.spec = spec
+        self.log_dir = log_dir
+        self._procs: List[subprocess.Popen] = []
+        self._cancelled = False
+
+    def _runner_for(self, ip: str) -> runner_lib.CommandRunner:
+        if self.spec.get('is_local', False) or ip in ('127.0.0.1',
+                                                      'localhost'):
+            return runner_lib.LocalProcessRunner(
+                workdir=self.spec.get('workdir_dest'))
+        return runner_lib.SSHCommandRunner(
+            ip, self.spec.get('ssh_user', 'skytpu'),
+            self.spec.get('ssh_key_path'))
+
+    @property
+    def host_ips(self) -> List[str]:
+        # nodes: [[host ips of node 0], [host ips of node 1], ...]
+        return [ip for node in self.spec.get('nodes', [['127.0.0.1']])
+                for ip in node]
+
+    def run_setup(self) -> int:
+        setup = self.spec.get('setup')
+        if not setup:
+            return 0
+        return self._fan_out(setup, phase='setup')
+
+    def run(self) -> int:
+        run_cmd = self.spec.get('run')
+        if not run_cmd:
+            return 0
+        return self._fan_out(run_cmd, phase='run', inject_rank_env=True)
+
+    def _fan_out(self, cmd: str, phase: str,
+                 inject_rank_env: bool = False) -> int:
+        ips = self.host_ips
+        chips = int(self.spec.get('chips_per_host', 0))
+        envs = dict(self.spec.get('envs', {}))
+        envs.update(self.spec.get('secrets', {}))
+        if self._cancelled:
+            return 130
+        procs = []
+        for rank, ip in enumerate(ips):
+            env = dict(envs)
+            if inject_rank_env:
+                env.update(build_host_env(ips, rank, chips))
+            log_path = os.path.join(self.log_dir, f'{phase}-{rank}.log')
+            runner = self._runner_for(ip)
+            workdir = self.spec.get('workdir_dest')
+            full_cmd = cmd
+            if workdir and not isinstance(runner,
+                                          runner_lib.LocalProcessRunner):
+                full_cmd = f'cd {workdir} && {cmd}'
+            procs.append(runner.popen(full_cmd, env=env,
+                                      log_path=log_path))
+        self._procs = procs
+        # Monitor loop: cancellable, and any host's failure is terminal.
+        import time
+        while True:
+            if self._cancelled:
+                self._kill_all()
+                return 130
+            rcs = [p.poll() for p in procs]
+            if all(rc is not None for rc in rcs):
+                # Any non-zero (incl. negative signal codes) fails the gang.
+                return next((rc for rc in rcs if rc != 0), 0)
+            time.sleep(0.2)
+
+    def _kill_all(self) -> None:
+        import signal
+        for p in self._procs:
+            if p.poll() is None:
+                try:
+                    # whole process group (popen uses start_new_session)
+                    os.killpg(p.pid, signal.SIGTERM)
+                except (ProcessLookupError, PermissionError):
+                    pass
+        deadline = 5.0
+        import time
+        t0 = time.time()
+        while time.time() - t0 < deadline:
+            if all(p.poll() is not None for p in self._procs):
+                return
+            time.sleep(0.1)
+        for p in self._procs:
+            if p.poll() is None:
+                try:
+                    os.killpg(p.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+
+    def cancel(self) -> None:
+        self._cancelled = True
+
+
+def run_gang_job(job_id: int, spec: Dict[str, Any], log_dir: str,
+                 status_cb, job: Optional['GangJob'] = None) -> int:
+    """Execute setup then run; status_cb(status_str, rc) on transitions.
+    Returns the final returncode."""
+    from skypilot_tpu.agent import job_queue
+    os.makedirs(log_dir, exist_ok=True)
+    if job is None:
+        job = GangJob(job_id, spec, log_dir)
+    status_cb(job_queue.JobStatus.SETTING_UP, None)
+    rc = job.run_setup()
+    if job._cancelled:  # pylint: disable=protected-access
+        status_cb(job_queue.JobStatus.CANCELLED, rc)
+        return rc
+    if rc != 0:
+        status_cb(job_queue.JobStatus.FAILED_SETUP, rc)
+        return rc
+    status_cb(job_queue.JobStatus.RUNNING, None)
+    rc = job.run()
+    if job._cancelled:  # pylint: disable=protected-access
+        status_cb(job_queue.JobStatus.CANCELLED, rc)
+        return rc
+    status_cb(job_queue.JobStatus.SUCCEEDED if rc == 0 else
+              job_queue.JobStatus.FAILED, rc)
+    return rc

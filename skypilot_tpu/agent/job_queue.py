@@ -1,0 +1,151 @@
+"""On-cluster job queue (parity: sky/skylet/job_lib.py).
+
+Jobs persist in sqlite on the head host; states mirror the reference's
+JobStatus (job_lib.py:156) minus Ray-specific ones.
+"""
+from __future__ import annotations
+
+import enum
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.utils import db_utils
+
+
+class JobStatus(enum.Enum):
+    PENDING = 'PENDING'
+    SETTING_UP = 'SETTING_UP'
+    RUNNING = 'RUNNING'
+    SUCCEEDED = 'SUCCEEDED'
+    FAILED = 'FAILED'
+    FAILED_SETUP = 'FAILED_SETUP'
+    CANCELLED = 'CANCELLED'
+
+    def is_terminal(self) -> bool:
+        return self in (JobStatus.SUCCEEDED, JobStatus.FAILED,
+                        JobStatus.FAILED_SETUP, JobStatus.CANCELLED)
+
+
+def _agent_home() -> str:
+    return os.path.expanduser(
+        os.environ.get('SKYTPU_AGENT_HOME', '~/.skytpu/agent'))
+
+
+def db_path() -> str:
+    return os.path.join(_agent_home(), 'jobs.db')
+
+
+def log_dir(job_id: int) -> str:
+    return os.path.join(_agent_home(), 'logs', str(job_id))
+
+
+_DDL = [
+    """CREATE TABLE IF NOT EXISTS jobs (
+        job_id INTEGER PRIMARY KEY AUTOINCREMENT,
+        name TEXT,
+        status TEXT,
+        submitted_at REAL,
+        started_at REAL,
+        ended_at REAL,
+        spec TEXT,
+        returncode INTEGER
+    )""",
+]
+
+
+def _ensure() -> str:
+    path = db_path()
+    db_utils.ensure_schema(path, _DDL)
+    return path
+
+
+def submit(name: Optional[str], spec: Dict[str, Any]) -> int:
+    path = _ensure()
+    with db_utils.transaction(path) as conn:
+        cur = conn.execute(
+            'INSERT INTO jobs (name, status, submitted_at, spec) '
+            'VALUES (?,?,?,?)',
+            (name, JobStatus.PENDING.value, time.time(), json.dumps(spec)))
+        return int(cur.lastrowid)
+
+
+def set_status(job_id: int, status: JobStatus,
+               returncode: Optional[int] = None) -> None:
+    path = _ensure()
+    # CANCELLED is sticky: a cancel that lands between the scheduler's
+    # dequeue and its first status write must not be overwritten by the
+    # gang's later SETTING_UP/RUNNING/SUCCEEDED transitions.
+    cur = db_utils.query_one(path,
+                             'SELECT status FROM jobs WHERE job_id=?',
+                             (job_id,))
+    if cur is not None and cur['status'] == JobStatus.CANCELLED.value and \
+            status is not JobStatus.CANCELLED:
+        return
+    now = time.time()
+    sets, params = ['status=?'], [status.value]
+    if status is JobStatus.RUNNING or status is JobStatus.SETTING_UP:
+        sets.append('started_at=COALESCE(started_at, ?)')
+        params.append(now)
+    if status.is_terminal():
+        sets.append('ended_at=?')
+        params.append(now)
+    if returncode is not None:
+        sets.append('returncode=?')
+        params.append(returncode)
+    params.append(job_id)
+    db_utils.execute(path, f'UPDATE jobs SET {", ".join(sets)} '
+                     'WHERE job_id=?', tuple(params))
+
+
+def get(job_id: int) -> Optional[Dict[str, Any]]:
+    row = db_utils.query_one(_ensure(),
+                             'SELECT * FROM jobs WHERE job_id=?', (job_id,))
+    return _row(row) if row else None
+
+
+def next_pending() -> Optional[Dict[str, Any]]:
+    row = db_utils.query_one(
+        _ensure(), 'SELECT * FROM jobs WHERE status=? '
+        'ORDER BY job_id LIMIT 1', (JobStatus.PENDING.value,))
+    return _row(row) if row else None
+
+
+def list_jobs(limit: int = 100) -> List[Dict[str, Any]]:
+    rows = db_utils.query(
+        _ensure(), 'SELECT * FROM jobs ORDER BY job_id DESC LIMIT ?',
+        (limit,))
+    return [_row(r) for r in rows]
+
+
+def any_active() -> bool:
+    row = db_utils.query_one(
+        _ensure(), 'SELECT COUNT(*) AS n FROM jobs WHERE status IN (?,?,?)',
+        (JobStatus.PENDING.value, JobStatus.SETTING_UP.value,
+         JobStatus.RUNNING.value))
+    return bool(row and row['n'])
+
+
+def last_activity_time() -> float:
+    """Newest of: submit/end times — idleness input for autostop
+    (parity: job_lib idleness, sky/skylet/job_lib.py:967)."""
+    row = db_utils.query_one(
+        _ensure(), 'SELECT MAX(submitted_at) AS s, MAX(ended_at) AS e '
+        'FROM jobs')
+    if row is None:
+        return 0.0
+    return max(float(row['s'] or 0.0), float(row['e'] or 0.0))
+
+
+def _row(row) -> Dict[str, Any]:
+    return {
+        'job_id': row['job_id'],
+        'name': row['name'],
+        'status': JobStatus(row['status']),
+        'submitted_at': row['submitted_at'],
+        'started_at': row['started_at'],
+        'ended_at': row['ended_at'],
+        'spec': json.loads(row['spec'] or '{}'),
+        'returncode': row['returncode'],
+    }

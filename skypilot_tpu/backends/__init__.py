@@ -1,0 +1,5 @@
+"""Execution backends (parity: sky/backends/)."""
+from skypilot_tpu.backends.backend import Backend
+from skypilot_tpu.backends.tpu_vm_backend import TpuVmBackend
+
+__all__ = ['Backend', 'TpuVmBackend']

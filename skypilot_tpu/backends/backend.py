@@ -1,0 +1,41 @@
+"""Backend contract (parity: sky/backends/backend.py:30-212).
+
+provision → sync_workdir → sync_file_mounts → setup → execute →
+post_execute → teardown; every method takes the cluster handle produced by
+provision."""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.global_user_state import ClusterHandle
+
+
+class Backend:
+    NAME = 'abstract'
+
+    def provision(self, task: task_lib.Task, cluster_name: str,
+                  dryrun: bool = False,
+                  retry_until_up: bool = False) -> Optional[ClusterHandle]:
+        raise NotImplementedError
+
+    def sync_workdir(self, handle: ClusterHandle, workdir: str) -> None:
+        raise NotImplementedError
+
+    def sync_file_mounts(self, handle: ClusterHandle,
+                         file_mounts: Dict[str, str]) -> None:
+        raise NotImplementedError
+
+    def setup(self, handle: ClusterHandle, task: task_lib.Task) -> None:
+        raise NotImplementedError
+
+    def execute(self, handle: ClusterHandle, task: task_lib.Task,
+                detach_run: bool = False) -> Optional[int]:
+        raise NotImplementedError
+
+    def post_execute(self, handle: ClusterHandle, job_id: Optional[int],
+                     down: bool = False) -> None:
+        del handle, job_id, down
+
+    def teardown(self, handle: ClusterHandle, terminate: bool = True) -> None:
+        raise NotImplementedError

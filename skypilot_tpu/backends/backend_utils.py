@@ -1,0 +1,72 @@
+"""Cluster status refresh (parity: backend_utils._update_cluster_status,
+sky/backends/backend_utils.py:2222).
+
+Reconciles the state DB against cloud truth via provision.query_instances —
+the primitive that detects preempted/deleted TPU slices for managed-job
+recovery and `status --refresh`.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import global_user_state
+from skypilot_tpu import provision as provision_lib
+from skypilot_tpu import sky_logging
+from skypilot_tpu.global_user_state import ClusterStatus
+from skypilot_tpu.provision.common import InstanceStatus
+from skypilot_tpu.utils import locks
+
+logger = sky_logging.init_logger(__name__)
+
+
+def refresh_cluster_status(name: str) -> Optional[ClusterStatus]:
+    """Query the cloud and reconcile; returns the refreshed status or None
+    if the cluster no longer exists anywhere."""
+    record = global_user_state.get_cluster(name)
+    if record is None:
+        return None
+    handle = record['handle']
+    with locks.cluster_lock(name, timeout=60.0):
+        try:
+            statuses = provision_lib.query_instances(
+                handle.cloud, name, region=handle.region, zone=handle.zone)
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning(f'status query failed for {name}: {e}')
+            return record['status']
+        if not statuses:
+            # All instances gone (externally deleted / fully preempted).
+            global_user_state.add_cluster_event(name, 'status_refresh',
+                                                'no instances found')
+            global_user_state.remove_cluster(name)
+            return None
+        values = list(statuses.values())
+        if any(s in (InstanceStatus.PREEMPTED, InstanceStatus.TERMINATED)
+               for s in values):
+            # Partial loss wedges a TPU slice: treat as INIT (unhealthy).
+            new_status = ClusterStatus.INIT
+        elif all(s is InstanceStatus.RUNNING for s in values):
+            new_status = ClusterStatus.UP
+        elif all(s is InstanceStatus.STOPPED for s in values):
+            new_status = ClusterStatus.STOPPED
+        else:
+            new_status = ClusterStatus.INIT
+        if new_status is not record['status']:
+            global_user_state.add_cluster_event(
+                name, 'status_refresh',
+                f'{record["status"].value} -> {new_status.value}')
+            global_user_state.set_cluster_status(name, new_status)
+        return new_status
+
+
+def refresh_all(cluster_names: Optional[List[str]] = None
+                ) -> List[Dict[str, Any]]:
+    records = global_user_state.get_clusters()
+    out = []
+    for rec in records:
+        if cluster_names and rec['name'] not in cluster_names:
+            continue
+        refresh_cluster_status(rec['name'])
+        fresh = global_user_state.get_cluster(rec['name'])
+        if fresh is not None:
+            out.append(fresh)
+    return out

@@ -1,0 +1,114 @@
+"""Imperative cluster ops (parity: sky/core.py — status :99, stop :732,
+down :697, autostop :797, queue :900, cancel :994, tail_logs :1091)."""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import clouds as clouds_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu.backends import TpuVmBackend
+from skypilot_tpu.backends import backend_utils
+from skypilot_tpu.global_user_state import ClusterStatus
+
+
+def status(cluster_names: Optional[List[str]] = None,
+           refresh: bool = False) -> List[Dict[str, Any]]:
+    if refresh:
+        return backend_utils.refresh_all(cluster_names)
+    records = global_user_state.get_clusters()
+    if cluster_names:
+        records = [r for r in records if r['name'] in cluster_names]
+    return records
+
+
+def _get_handle(cluster_name: str):
+    record = global_user_state.get_cluster(cluster_name)
+    if record is None:
+        raise exceptions.ClusterDoesNotExistError(
+            f'Cluster {cluster_name!r} does not exist.')
+    return record
+
+
+def down(cluster_name: str) -> None:
+    record = _get_handle(cluster_name)
+    TpuVmBackend().teardown(record['handle'], terminate=True)
+
+
+def stop(cluster_name: str) -> None:
+    record = _get_handle(cluster_name)
+    res = record['handle'].launched_resources()
+    clouds_lib.get_cloud(record['handle'].cloud).check_capability(
+        clouds_lib.CloudCapability.STOP, res)
+    TpuVmBackend().teardown(record['handle'], terminate=False)
+
+
+def start(cluster_name: str) -> None:
+    """Restart a STOPPED cluster on its original placement."""
+    record = _get_handle(cluster_name)
+    if record['status'] is ClusterStatus.UP:
+        return
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu import resources as resources_lib
+    t = task_lib.Task(None)
+    t.set_resources(resources_lib.Resources.from_yaml_config(
+        dict(record['resources'])))
+    t.num_nodes = record['handle'].num_nodes
+    # provision() takes the cluster lock and routes STOPPED clusters
+    # through the in-place restart path.
+    TpuVmBackend().provision(t, cluster_name)
+
+
+def autostop(cluster_name: str, idle_minutes: int,
+             down_flag: bool = False) -> None:
+    record = _get_handle(cluster_name)
+    handle = record['handle']
+    res = handle.launched_resources()
+    if not down_flag:
+        clouds_lib.get_cloud(handle.cloud).check_capability(
+            clouds_lib.CloudCapability.AUTOSTOP, res)
+    backend = TpuVmBackend()
+    client = backend._agent_client(handle)  # pylint: disable=protected-access
+    try:
+        client.set_autostop(idle_minutes, down_flag)
+    finally:
+        client.close()
+    global_user_state.set_cluster_autostop(cluster_name, idle_minutes,
+                                           down_flag)
+
+
+def queue(cluster_name: str) -> List[Dict[str, Any]]:
+    record = _get_handle(cluster_name)
+    return TpuVmBackend().job_queue(record['handle'])
+
+
+def cancel(cluster_name: str, job_id: int) -> bool:
+    record = _get_handle(cluster_name)
+    return TpuVmBackend().cancel_job(record['handle'], job_id)
+
+
+def tail_logs(cluster_name: str, job_id: int, follow: bool = True) -> int:
+    record = _get_handle(cluster_name)
+    return TpuVmBackend().tail_logs(record['handle'], job_id, follow=follow)
+
+
+def cost_report() -> List[Dict[str, Any]]:
+    """Rough accrued cost per live cluster (reference: sky/core.py:375)."""
+    import time
+    out = []
+    for rec in global_user_state.get_clusters():
+        res = rec['handle'].launched_resources()
+        try:
+            from skypilot_tpu import catalog
+            hourly = catalog.get_hourly_cost(res) * rec['handle'].num_nodes
+        except exceptions.SkyTpuError:
+            hourly = 0.0
+        hours = max(0.0, time.time() - rec['launched_at']) / 3600.0
+        out.append({
+            'name': rec['name'],
+            'status': rec['status'],
+            'hourly_cost': hourly,
+            'accrued_cost': hourly * hours if
+            rec['status'] is not ClusterStatus.STOPPED else 0.0,
+        })
+    return out

@@ -1,0 +1,1 @@
+"""Data & storage layer (parity: sky/data/)."""

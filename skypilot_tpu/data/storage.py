@@ -1,0 +1,117 @@
+"""Storage object model: buckets mounted/copied into clusters.
+
+Parity: sky/data/storage.py (Storage :560, AbstractStore :320, modes :128).
+GCS is the first-class store (TPU clusters live in GCP; gcsfuse is
+preinstalled on TPU VMs); S3/R2 ride the same interface via their CLIs.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+import shlex
+from typing import Dict, Optional, TYPE_CHECKING
+
+from skypilot_tpu import exceptions
+
+if TYPE_CHECKING:
+    from skypilot_tpu.backends import tpu_vm_backend
+    from skypilot_tpu.global_user_state import ClusterHandle
+
+
+class StoreType(enum.Enum):
+    GCS = 'gcs'
+    S3 = 's3'
+    R2 = 'r2'
+
+    @classmethod
+    def from_url(cls, url: str) -> 'StoreType':
+        scheme = url.split('://', 1)[0]
+        try:
+            return {'gs': cls.GCS, 's3': cls.S3, 'r2': cls.R2}[scheme]
+        except KeyError:
+            raise exceptions.StorageError(
+                f'Unsupported store URL scheme: {url}') from None
+
+
+class StorageMode(enum.Enum):
+    MOUNT = 'MOUNT'
+    COPY = 'COPY'
+    MOUNT_CACHED = 'MOUNT_CACHED'
+
+
+@dataclasses.dataclass
+class StorageMount:
+    """One `file_mounts:` entry whose value is a storage config dict."""
+    mount_path: str
+    source: str                      # gs://bucket[/prefix]
+    mode: StorageMode = StorageMode.MOUNT
+    name: Optional[str] = None
+
+    @classmethod
+    def from_yaml_config(cls, mount_path: str,
+                         config: Dict) -> 'StorageMount':
+        return cls(
+            mount_path=mount_path,
+            source=config.get('source', ''),
+            mode=StorageMode(config.get('mode', 'MOUNT').upper()),
+            name=config.get('name'),
+        )
+
+
+def copy_command(source: str, dst: str) -> str:
+    """CLI download command for COPY mode (parity: sky/cloud_stores.py)."""
+    store = StoreType.from_url(source)
+    q = shlex.quote
+    if store is StoreType.GCS:
+        return (f'mkdir -p {q(dst)} && '
+                f'gsutil -m rsync -r {q(source)} {q(dst)}')
+    if store is StoreType.S3:
+        return (f'mkdir -p {q(dst)} && '
+                f'aws s3 sync {q(source)} {q(dst)}')
+    raise exceptions.StorageError(f'COPY unsupported for {store}')
+
+
+def mount_command(source: str, mount_path: str,
+                  cached: bool = False) -> str:
+    """FUSE mount command (parity: sky/data/mounting_utils.py; gcsfuse for
+    GCS, MOUNT_CACHED via gcsfuse file cache)."""
+    store = StoreType.from_url(source)
+    q = shlex.quote
+    if store is not StoreType.GCS:
+        raise exceptions.StorageError(
+            f'MOUNT currently supports gs:// only, got {source}')
+    bucket_and_prefix = source[len('gs://'):]
+    bucket = bucket_and_prefix.split('/', 1)[0]
+    flags = '--implicit-dirs'
+    if cached:
+        flags += (' --file-cache-max-size-mb -1 '
+                  '--cache-dir ~/.skytpu/gcsfuse-cache')
+    return (f'mkdir -p {q(mount_path)} && '
+            f'(mountpoint -q {q(mount_path)} || '
+            f'gcsfuse {flags} {q(bucket)} {q(mount_path)})')
+
+
+def fetch_bucket_to_cluster(backend: 'tpu_vm_backend.TpuVmBackend',
+                            handle: 'ClusterHandle', source: str,
+                            dst: str) -> None:
+    """COPY-mode bucket fetch on every host (file_mounts with bucket URI)."""
+    cmd = copy_command(source, dst)
+    for runner in backend._host_runners(handle):  # pylint: disable=protected-access
+        rc = runner.run(cmd)
+        if rc != 0:
+            raise exceptions.StorageError(
+                f'bucket fetch failed on {runner.host}: {source}')
+
+
+def mount_on_cluster(backend: 'tpu_vm_backend.TpuVmBackend',
+                     handle: 'ClusterHandle', mount: StorageMount) -> None:
+    if mount.mode is StorageMode.COPY:
+        return fetch_bucket_to_cluster(backend, handle, mount.source,
+                                       mount.mount_path)
+    cmd = mount_command(mount.source, mount.mount_path,
+                        cached=mount.mode is StorageMode.MOUNT_CACHED)
+    for runner in backend._host_runners(handle):  # pylint: disable=protected-access
+        rc = runner.run(cmd)
+        if rc != 0:
+            raise exceptions.StorageError(
+                f'mount failed on {runner.host}: {mount.source}')

@@ -1,0 +1,106 @@
+"""Life-cycle driver (parity: sky/execution.py).
+
+Stages OPTIMIZE → PROVISION → SYNC_WORKDIR → SYNC_FILE_MOUNTS → SETUP →
+EXEC (reference Stage enum, sky/execution.py:41-52; CLONE_DISK and PRE_EXEC
+have no TPU analog).  `launch` runs all stages; `exec_` skips
+optimize/provision/setup for fast iteration on a live cluster
+(sky/execution.py:736 semantics).
+"""
+from __future__ import annotations
+
+import enum
+from typing import List, Optional, Tuple
+
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import sky_logging
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.backends import TpuVmBackend
+from skypilot_tpu.global_user_state import ClusterHandle, ClusterStatus
+from skypilot_tpu.optimizer import Optimizer, OptimizeTarget
+from skypilot_tpu.utils import common_utils
+
+logger = sky_logging.init_logger(__name__)
+
+
+class Stage(enum.Enum):
+    OPTIMIZE = 'optimize'
+    PROVISION = 'provision'
+    SYNC_WORKDIR = 'sync_workdir'
+    SYNC_FILE_MOUNTS = 'sync_file_mounts'
+    SETUP = 'setup'
+    EXEC = 'exec'
+
+
+def launch(
+    task: task_lib.Task,
+    cluster_name: Optional[str] = None,
+    *,
+    minimize: OptimizeTarget = OptimizeTarget.COST,
+    dryrun: bool = False,
+    detach_run: bool = False,
+    stages: Optional[List[Stage]] = None,
+    quiet_optimizer: bool = False,
+) -> Tuple[Optional[int], Optional[ClusterHandle]]:
+    """Provision (or reuse) a cluster and run the task on it.
+
+    Returns (job_id, handle).  (reference: sky/execution.py:539)
+    """
+    cluster_name = cluster_name or f'sky-{common_utils.generate_id()}'
+    common_utils.validate_cluster_name(cluster_name)
+    stages = stages or list(Stage)
+    backend = TpuVmBackend()
+
+    if Stage.OPTIMIZE in stages:
+        existing = global_user_state.get_cluster(cluster_name)
+        if existing is None or existing['status'] is not ClusterStatus.UP:
+            Optimizer.optimize(dag_lib.dag_from_task(task),
+                               minimize=minimize, quiet=quiet_optimizer)
+    if dryrun:
+        logger.info('Dry run finished (plan above).')
+        return None, None
+
+    handle: Optional[ClusterHandle] = None
+    if Stage.PROVISION in stages:
+        handle = backend.provision(task, cluster_name)
+    else:
+        record = global_user_state.get_cluster(cluster_name)
+        if record is None:
+            raise exceptions.ClusterDoesNotExistError(
+                f'Cluster {cluster_name!r} does not exist.')
+        handle = record['handle']
+    assert handle is not None
+
+    if Stage.SYNC_WORKDIR in stages and task.workdir:
+        backend.sync_workdir(handle, task.workdir)
+    if Stage.SYNC_FILE_MOUNTS in stages and task.file_mounts:
+        backend.sync_file_mounts(handle, task.file_mounts)
+    if Stage.SETUP in stages and task.setup:
+        backend.setup(handle, task)
+
+    job_id: Optional[int] = None
+    if Stage.EXEC in stages and task.run is not None:
+        job_id = backend.execute(handle, task, detach_run=detach_run)
+    return job_id, handle
+
+
+def exec_(
+    task: task_lib.Task,
+    cluster_name: str,
+    detach_run: bool = False,
+) -> Tuple[Optional[int], ClusterHandle]:
+    """Run on an existing cluster, skipping provision/setup
+    (reference: sky/execution.py:736)."""
+    record = global_user_state.get_cluster(cluster_name)
+    if record is None:
+        raise exceptions.ClusterDoesNotExistError(
+            f'Cluster {cluster_name!r} does not exist; launch it first.')
+    if record['status'] is not ClusterStatus.UP:
+        raise exceptions.ClusterNotUpError(
+            f'Cluster {cluster_name!r} is {record["status"].value}.')
+    stages = [Stage.SYNC_WORKDIR, Stage.EXEC]
+    job_id, handle = launch(task, cluster_name, stages=stages,
+                            detach_run=detach_run)
+    assert handle is not None
+    return job_id, handle

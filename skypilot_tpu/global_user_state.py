@@ -1,0 +1,195 @@
+"""Global state: clusters, their handles, and lifecycle events.
+
+Parity: sky/global_user_state.py (cluster_table :88, events).  The cluster
+*handle* — everything the backend needs to reattach to a provisioned slice
+(zone, node/worker ips, TPU instance names, ssh config) — is stored as JSON,
+not a pickle: JSON survives version skew between client and controllers,
+which is where the reference's pickled handles bite
+(cloud_vm_ray_backend.py:2501 pickles the handle into the DB).
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import db_utils
+
+
+class ClusterStatus(enum.Enum):
+    INIT = 'INIT'          # provisioning or partially up
+    UP = 'UP'
+    STOPPED = 'STOPPED'
+
+    def colored(self) -> str:
+        return self.value
+
+
+def _db_path() -> str:
+    return os.path.expanduser(
+        os.environ.get('SKYTPU_STATE_DB', '~/.skytpu/state.db'))
+
+
+_DDL = [
+    """CREATE TABLE IF NOT EXISTS clusters (
+        name TEXT PRIMARY KEY,
+        launched_at INTEGER,
+        last_use TEXT,
+        status TEXT,
+        autostop_minutes INTEGER DEFAULT -1,
+        autostop_down INTEGER DEFAULT 0,
+        owner TEXT,
+        handle TEXT,
+        resources TEXT,
+        status_updated_at INTEGER
+    )""",
+    """CREATE TABLE IF NOT EXISTS cluster_events (
+        cluster_name TEXT,
+        timestamp INTEGER,
+        event TEXT,
+        detail TEXT
+    )""",
+    """CREATE INDEX IF NOT EXISTS idx_events_cluster
+       ON cluster_events (cluster_name)""",
+]
+
+
+def _ensure() -> str:
+    path = _db_path()
+    db_utils.ensure_schema(path, _DDL)
+    return path
+
+
+@dataclasses.dataclass
+class ClusterHandle:
+    """Reattachable description of a provisioned cluster.
+
+    node_ips: one entry per *logical* node; each entry lists the host IPs of
+    that node (len > 1 for multi-host TPU slices — the analog of the
+    reference's `num_ips_per_node` fan-out, cloud_vm_ray_backend.py:2485).
+    """
+    cluster_name: str
+    cloud: str
+    region: Optional[str] = None
+    zone: Optional[str] = None
+    resources_config: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    num_nodes: int = 1
+    node_ips: List[List[str]] = dataclasses.field(default_factory=list)
+    instance_names: List[str] = dataclasses.field(default_factory=list)
+    ssh_user: str = 'skytpu'
+    ssh_key_path: Optional[str] = None
+    local_dirs: List[str] = dataclasses.field(default_factory=list)
+    agent_port: int = 8790
+    extras: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @property
+    def head_ip(self) -> Optional[str]:
+        if self.node_ips and self.node_ips[0]:
+            return self.node_ips[0][0]
+        return None
+
+    @property
+    def all_host_ips(self) -> List[str]:
+        return [ip for node in self.node_ips for ip in node]
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+    @classmethod
+    def from_json(cls, blob: str) -> 'ClusterHandle':
+        return cls(**json.loads(blob))
+
+    def launched_resources(self):
+        from skypilot_tpu import resources as resources_lib
+        return resources_lib.Resources.from_yaml_config(
+            dict(self.resources_config))
+
+
+def add_or_update_cluster(name: str,
+                          handle: ClusterHandle,
+                          status: ClusterStatus = ClusterStatus.INIT,
+                          is_launch: bool = False) -> None:
+    path = _ensure()
+    now = int(time.time())
+    existing = db_utils.query_one(path,
+                                  'SELECT name FROM clusters WHERE name=?',
+                                  (name,))
+    if existing is None:
+        db_utils.execute(
+            path, 'INSERT INTO clusters (name, launched_at, last_use, '
+            'status, owner, handle, resources, status_updated_at) '
+            'VALUES (?,?,?,?,?,?,?,?)',
+            (name, now, ' '.join(os.sys.argv[:2]), status.value,
+             common_utils.get_user_hash(), handle.to_json(),
+             json.dumps(handle.resources_config), now))
+    else:
+        db_utils.execute(
+            path, 'UPDATE clusters SET status=?, handle=?, resources=?, '
+            'status_updated_at=?' + (', launched_at=?' if is_launch else '') +
+            ' WHERE name=?',
+            (status.value, handle.to_json(),
+             json.dumps(handle.resources_config), now) +
+            ((now, name) if is_launch else (name,)))
+
+
+def set_cluster_status(name: str, status: ClusterStatus) -> None:
+    db_utils.execute(
+        _ensure(),
+        'UPDATE clusters SET status=?, status_updated_at=? WHERE name=?',
+        (status.value, int(time.time()), name))
+
+
+def set_cluster_autostop(name: str, idle_minutes: int, down: bool) -> None:
+    db_utils.execute(
+        _ensure(),
+        'UPDATE clusters SET autostop_minutes=?, autostop_down=? '
+        'WHERE name=?', (idle_minutes, int(down), name))
+
+
+def remove_cluster(name: str) -> None:
+    path = _ensure()
+    db_utils.execute(path, 'DELETE FROM clusters WHERE name=?', (name,))
+
+
+def get_cluster(name: str) -> Optional[Dict[str, Any]]:
+    row = db_utils.query_one(_ensure(),
+                             'SELECT * FROM clusters WHERE name=?', (name,))
+    return _row_to_record(row) if row else None
+
+
+def get_clusters() -> List[Dict[str, Any]]:
+    rows = db_utils.query(_ensure(),
+                          'SELECT * FROM clusters ORDER BY launched_at DESC')
+    return [_row_to_record(r) for r in rows]
+
+
+def _row_to_record(row) -> Dict[str, Any]:
+    return {
+        'name': row['name'],
+        'launched_at': row['launched_at'],
+        'status': ClusterStatus(row['status']),
+        'autostop_minutes': row['autostop_minutes'],
+        'autostop_down': bool(row['autostop_down']),
+        'owner': row['owner'],
+        'handle': ClusterHandle.from_json(row['handle']),
+        'resources': json.loads(row['resources'] or '{}'),
+        'status_updated_at': row['status_updated_at'],
+    }
+
+
+def add_cluster_event(name: str, event: str, detail: str = '') -> None:
+    db_utils.execute(
+        _ensure(),
+        'INSERT INTO cluster_events (cluster_name, timestamp, event, detail)'
+        ' VALUES (?,?,?,?)', (name, int(time.time()), event, detail))
+
+
+def get_cluster_events(name: str) -> List[Dict[str, Any]]:
+    rows = db_utils.query(
+        _ensure(), 'SELECT * FROM cluster_events WHERE cluster_name=? '
+        'ORDER BY timestamp', (name,))
+    return [dict(r) for r in rows]

@@ -1,0 +1,77 @@
+"""Provision-layer data model (parity: sky/provision/common.py).
+
+The unit of provisioning is the *node*: for TPU slices one node is one TPU
+resource (which brings `num_hosts` host VMs with it — the API allocates them
+atomically); for VM/local clouds one node is one instance.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Dict, List, Optional
+
+
+class InstanceStatus(enum.Enum):
+    PENDING = 'PENDING'        # creating / queued
+    RUNNING = 'RUNNING'
+    STOPPED = 'STOPPED'
+    PREEMPTED = 'PREEMPTED'    # spot reclaim; stale resource may linger
+    TERMINATED = 'TERMINATED'
+
+
+@dataclasses.dataclass
+class InstanceInfo:
+    instance_id: str
+    status: InstanceStatus
+    # One entry per host VM of this node (TPU pods: num_hosts entries).
+    internal_ips: List[str] = dataclasses.field(default_factory=list)
+    external_ips: List[str] = dataclasses.field(default_factory=list)
+    tags: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class ProvisionConfig:
+    """Everything a provider needs to create the cluster's nodes."""
+    cluster_name: str
+    num_nodes: int
+    resources_config: Dict[str, Any]      # Resources.to_yaml_config()
+    region: Optional[str] = None
+    zone: Optional[str] = None
+    authorized_key: Optional[str] = None  # pubkey to inject for SSH
+    labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+    ports: List[str] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class ProvisionRecord:
+    """Result of run_instances (parity: reference ProvisionRecord)."""
+    provider_name: str
+    cluster_name: str
+    region: Optional[str]
+    zone: Optional[str]
+    instance_ids: List[str]
+    resumed: bool = False       # reused existing stopped/running nodes
+
+
+@dataclasses.dataclass
+class ClusterInfo:
+    """Post-provision cluster description (parity: get_cluster_info)."""
+    provider_name: str
+    cluster_name: str
+    instances: List[InstanceInfo] = dataclasses.field(default_factory=list)
+    ssh_user: str = 'skytpu'
+    ssh_port: int = 22
+
+    @property
+    def node_ips(self) -> List[List[str]]:
+        """Per node, the host IPs (external preferred, internal fallback)."""
+        out = []
+        for inst in self.instances:
+            ips = inst.external_ips or inst.internal_ips
+            out.append(list(ips))
+        return out
+
+    @property
+    def head_ip(self) -> Optional[str]:
+        ips = self.node_ips
+        return ips[0][0] if ips and ips[0] else None

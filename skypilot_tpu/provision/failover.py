@@ -1,0 +1,90 @@
+"""Stockout failover engine (parity: RetryingVmProvisioner,
+cloud_vm_ray_backend.py:729).
+
+Walks the optimizer's cheapest-first candidate placements; on a typed
+provision failure it blocklists the zone (stockout) or the whole region
+(quota — reference blocklist semantics, cloud_vm_ray_backend.py:325), then
+re-optimizes with the accumulated blocklist and tries the next placement.
+Each failure is recorded in the failover history surfaced to the user on
+final failure.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.optimizer import Optimizer, OptimizeTarget
+from skypilot_tpu.provision import common as provision_common
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+
+@dataclasses.dataclass
+class ProvisionAttemptResult:
+    record: provision_common.ProvisionRecord
+    resources: resources_lib.Resources
+
+
+def _blocklist_entry(
+        candidate: resources_lib.Resources,
+        blocklist_region: bool) -> resources_lib.Resources:
+    """Resources pattern to block: zone-level by default, region-level for
+    quota errors."""
+    infra = f'{candidate.cloud}/{candidate.region}'
+    if not blocklist_region and candidate.zone:
+        infra += f'/{candidate.zone}'
+    return resources_lib.Resources.from_yaml_config({'infra': infra})
+
+
+def provision_with_retries(
+    task: task_lib.Task,
+    cluster_name: str,
+    provision_fn: Callable[[resources_lib.Resources],
+                           provision_common.ProvisionRecord],
+    max_attempts: int = 16,
+    blocked_resources: Optional[List[resources_lib.Resources]] = None,
+) -> ProvisionAttemptResult:
+    """Try placements until one provisions.
+
+    provision_fn(candidate) must raise a typed ProvisionError subclass on
+    failure; its `blocklist_region` attribute chooses the blocklist scope.
+    The task is re-optimized (cheapest surviving placement) between
+    attempts — the reference does the same full re-plan per retry round.
+    """
+    blocked: List[resources_lib.Resources] = list(blocked_resources or [])
+    history: List[Exception] = []
+    for attempt in range(max_attempts):
+        single = dag_lib.dag_from_task(task)
+        try:
+            Optimizer.optimize(single, minimize=OptimizeTarget.COST,
+                               blocked_resources=blocked, quiet=True)
+        except exceptions.ResourcesUnavailableError as e:
+            raise exceptions.ResourcesUnavailableError(
+                f'Provisioning {cluster_name!r} failed after exhausting '
+                f'all placements ({attempt} attempts).\n'
+                + exceptions.format_failover_history(history)
+            ).with_failover_history(history) from e
+        candidate = task.best_resources
+        assert candidate is not None
+        try:
+            record = provision_fn(candidate)
+            return ProvisionAttemptResult(record, candidate)
+        except exceptions.ProvisionError as e:
+            history.append(e)
+            entry = _blocklist_entry(candidate, e.blocklist_region)
+            blocked.append(entry)
+            scope = 'region' if e.blocklist_region else 'zone'
+            logger.warning(
+                f'Provision attempt {attempt + 1} in '
+                f'{candidate.region}/{candidate.zone} failed '
+                f'({type(e).__name__}); blocklisting {scope} and '
+                f'failing over.')
+    raise exceptions.ResourcesUnavailableError(
+        f'Provisioning {cluster_name!r} failed: {max_attempts} attempts '
+        f'exhausted.\n' + exceptions.format_failover_history(history)
+    ).with_failover_history(history)

@@ -1,0 +1,229 @@
+"""GCP TPU provisioner implementing the dispatch API.
+
+One logical node == one TPU resource (a whole slice; multi-host slices get
+all their host VMs atomically from the TPU API — no per-VM gang scheduling
+needed, unlike the reference's GPU path).  Node naming:
+``<cluster>-<i>`` for node i; queued-resource ids mirror node ids.
+
+TPU semantics carried from the reference:
+- pods (multi-host) cannot stop — only delete (sky/clouds/gcp.py:219-226);
+- preempted spot TPUs leave a stale PREEMPTED node that must be deleted
+  before re-creating (gcp.py:1095-1101) — run_instances reconciles this;
+- queued resources are used for spot and large slices, direct create for
+  small on-demand slices (instance_utils.py:1501 retry-on-stockout analog).
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.gcp import tpu_client as tpu_client_lib
+
+# TPU node states → framework InstanceStatus.
+_STATE_MAP = {
+    'CREATING': common.InstanceStatus.PENDING,
+    'STARTING': common.InstanceStatus.PENDING,
+    'RESTARTING': common.InstanceStatus.PENDING,
+    'REPAIRING': common.InstanceStatus.PENDING,
+    'READY': common.InstanceStatus.RUNNING,
+    'STOPPING': common.InstanceStatus.STOPPED,
+    'STOPPED': common.InstanceStatus.STOPPED,
+    'PREEMPTED': common.InstanceStatus.PREEMPTED,
+    'TERMINATED': common.InstanceStatus.TERMINATED,
+    'DELETING': common.InstanceStatus.TERMINATED,
+}
+
+_CLUSTER_LABEL = 'skytpu-cluster'
+
+
+def _client() -> tpu_client_lib.TpuClient:
+    return tpu_client_lib.TpuClient(tpu_client_lib.default_project())
+
+
+def _node_id(cluster_name: str, i: int) -> str:
+    return f'{cluster_name}-{i}'
+
+
+def _cluster_nodes(client: tpu_client_lib.TpuClient, zone: str,
+                   cluster_name: str) -> Dict[str, dict]:
+    out = {}
+    for node in client.list_nodes(zone):
+        labels = node.get('labels', {})
+        if labels.get(_CLUSTER_LABEL) == cluster_name:
+            out[node['name'].rsplit('/', 1)[-1]] = node
+    return out
+
+
+def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
+    if config.zone is None:
+        raise exceptions.ProvisionError(
+            'GCP TPU provisioning requires a concrete zone '
+            '(the optimizer/failover engine supplies one).')
+    res = resources_lib.Resources.from_yaml_config(
+        dict(config.resources_config))
+    tpu = res.tpu
+    if tpu is None:
+        raise exceptions.ProvisionError(
+            'GCP provisioner currently provisions TPU slices only; '
+            'CPU controllers run on the local cloud or kubernetes.')
+    client = _client()
+    zone = config.zone
+    existing = _cluster_nodes(client, zone, config.cluster_name)
+    labels = dict(config.labels)
+    labels[_CLUSTER_LABEL] = config.cluster_name
+    metadata = {}
+    if config.authorized_key:
+        # TPU VMs honor ssh-keys metadata like GCE.
+        metadata['ssh-keys'] = f'skytpu:{config.authorized_key}'
+
+    instance_ids = []
+    resumed = False
+    use_qr = res.use_spot or tpu.is_pod   # queued path for spot/pods
+    for i in range(config.num_nodes):
+        node_id = _node_id(config.cluster_name, i)
+        instance_ids.append(node_id)
+        node = existing.get(node_id)
+        state = node.get('state') if node else None
+        if state == 'READY':
+            resumed = True
+            continue
+        if state in ('STOPPED', 'STOPPING'):
+            client.start_node(zone, node_id)
+            resumed = True
+            continue
+        if state in ('PREEMPTED', 'TERMINATED', 'FAILED'):
+            # Stale spot node: must delete before re-create
+            # (reference: sky/clouds/gcp.py:1095-1101).
+            client.delete_queued_resource(zone, node_id)
+            client.delete_node(zone, node_id)
+        if use_qr:
+            client.delete_queued_resource(zone, node_id)
+            client.create_queued_resource(
+                zone, qr_id=node_id, node_id=node_id,
+                accelerator_type=tpu.gcp_accelerator_type,
+                runtime_version=res.tpu_runtime_version,
+                spot=res.use_spot, labels=labels, metadata=metadata)
+        else:
+            client.create_node(
+                zone, node_id,
+                accelerator_type=tpu.gcp_accelerator_type,
+                runtime_version=res.tpu_runtime_version,
+                spot=False, labels=labels, metadata=metadata)
+    return common.ProvisionRecord('gcp', config.cluster_name,
+                                  config.region, zone, instance_ids,
+                                  resumed=resumed)
+
+
+def _cluster_queued_resources(client: tpu_client_lib.TpuClient, zone: str,
+                              cluster_name: str) -> List[str]:
+    out = []
+    for qr in client.list_queued_resources(zone):
+        specs = qr.get('tpu', {}).get('nodeSpec', [])
+        labels = specs[0].get('node', {}).get('labels', {}) if specs else {}
+        if labels.get(_CLUSTER_LABEL) == cluster_name:
+            out.append(qr['name'].rsplit('/', 1)[-1])
+    return out
+
+
+def wait_instances(cluster_name: str, region=None, zone=None,
+                   timeout_s: float = 1800.0) -> None:
+    client = _client()
+    # Queued-resource path first: wait until each QR is ACTIVE (the TPU
+    # scheduler materializes the node atomically at that point).
+    for qr_id in _cluster_queued_resources(client, zone, cluster_name):
+        client.wait_queued_resource_active(zone, qr_id,
+                                           timeout_s=timeout_s)
+    deadline = time.time() + timeout_s
+    while True:
+        statuses = query_instances(cluster_name, region, zone)
+        if not statuses:
+            raise exceptions.ProvisionError(
+                f'no TPU nodes found for cluster {cluster_name} in {zone}')
+        if all(s is common.InstanceStatus.RUNNING
+               for s in statuses.values()):
+            return
+        bad = {k: s for k, s in statuses.items() if s in
+               (common.InstanceStatus.PREEMPTED,
+                common.InstanceStatus.TERMINATED)}
+        if bad:
+            raise exceptions.InsufficientCapacityError(
+                f'TPU nodes failed during provisioning: {bad}')
+        if time.time() > deadline:
+            raise exceptions.QueuedResourceTimeoutError(
+                f'cluster {cluster_name} not READY in {timeout_s}s: '
+                f'{statuses}')
+        time.sleep(10.0)
+    del client
+
+
+def query_instances(cluster_name: str, region=None,
+                    zone=None) -> Dict[str, common.InstanceStatus]:
+    client = _client()
+    nodes = _cluster_nodes(client, zone, cluster_name)
+    return {
+        node_id: _STATE_MAP.get(node.get('state', ''),
+                                common.InstanceStatus.PENDING)
+        for node_id, node in nodes.items()
+    }
+
+
+def stop_instances(cluster_name: str, region=None, zone=None) -> None:
+    client = _client()
+    for node_id, node in _cluster_nodes(client, zone, cluster_name).items():
+        accel = node.get('acceleratorType', '')
+        # Multi-host slice: no stop support in the TPU API.
+        from skypilot_tpu import accelerators as acc_lib
+        if acc_lib.is_tpu(f'tpu-{accel}') and \
+                acc_lib.parse_tpu(f'tpu-{accel}').is_pod:
+            raise exceptions.NotSupportedError(
+                f'TPU pod slice {node_id} ({accel}) cannot be stopped; '
+                'use down instead.')
+        client.stop_node(zone, node_id)
+
+
+def terminate_instances(cluster_name: str, region=None, zone=None) -> None:
+    client = _client()
+    # Parked queued-resources whose node never materialized need explicit
+    # deletion too (otherwise they later claim capacity for a dead cluster).
+    for qr_id in _cluster_queued_resources(client, zone, cluster_name):
+        client.delete_queued_resource(zone, qr_id)
+    for node_id in _cluster_nodes(client, zone, cluster_name):
+        client.delete_queued_resource(zone, node_id)
+        client.delete_node(zone, node_id)
+
+
+def get_cluster_info(cluster_name: str, region=None,
+                     zone=None) -> common.ClusterInfo:
+    client = _client()
+    instances: List[common.InstanceInfo] = []
+    def _numeric_key(item):
+        # '<cluster>-<i>': order by node index, not lexicographically
+        # (lexicographic puts node 10 before node 2).
+        node_id = item[0]
+        suffix = node_id.rsplit('-', 1)[-1]
+        return (int(suffix) if suffix.isdigit() else 1 << 30, node_id)
+
+    for node_id, node in sorted(
+            _cluster_nodes(client, zone, cluster_name).items(),
+            key=_numeric_key):
+        internal, external = [], []
+        for ep in node.get('networkEndpoints', []):
+            if ep.get('ipAddress'):
+                internal.append(ep['ipAddress'])
+            access = ep.get('accessConfig', {})
+            if access.get('externalIp'):
+                external.append(access['externalIp'])
+        instances.append(
+            common.InstanceInfo(
+                instance_id=node_id,
+                status=_STATE_MAP.get(node.get('state', ''),
+                                      common.InstanceStatus.PENDING),
+                internal_ips=internal,
+                external_ips=external,
+                tags=node.get('labels', {}),
+            ))
+    return common.ClusterInfo('gcp', cluster_name, instances,
+                              ssh_user='skytpu')

@@ -1,0 +1,138 @@
+"""Local provisioner: nodes are directories + state files on this machine.
+
+The hermetic analog of the reference's mocked-cloud test path and
+LocalDockerBackend: the full launch pipeline (provision → bootstrap → gang
+execute → logs → down) runs against it with no cloud account, and tests
+inject preemptions by flipping a node's state file — the same failure
+surface query_instances exposes for real TPU slices.
+
+Simulated TPU pods: a node whose resources request a multi-host slice gets
+`num_hosts` host entries (same fan-out the gang executor sees on GCP).
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+from typing import Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu.provision import common
+
+
+def _root() -> str:
+    return os.path.expanduser(
+        os.environ.get('SKYTPU_LOCAL_INSTANCE_DIR',
+                       '~/.skytpu/local_instances'))
+
+
+def _cluster_dir(cluster_name: str) -> str:
+    return os.path.join(_root(), cluster_name)
+
+
+def _node_state_path(cluster_name: str, node_id: str) -> str:
+    return os.path.join(_cluster_dir(cluster_name), node_id, 'state.json')
+
+
+def _write_state(cluster_name: str, node_id: str, state: dict) -> None:
+    path = _node_state_path(cluster_name, node_id)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, 'w', encoding='utf-8') as f:
+        json.dump(state, f)
+
+
+def _read_states(cluster_name: str) -> Dict[str, dict]:
+    cdir = _cluster_dir(cluster_name)
+    out = {}
+    if not os.path.isdir(cdir):
+        return out
+    for node_id in sorted(os.listdir(cdir)):
+        path = _node_state_path(cluster_name, node_id)
+        if os.path.exists(path):
+            with open(path, encoding='utf-8') as f:
+                out[node_id] = json.load(f)
+    return out
+
+
+def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
+    res = resources_lib.Resources.from_yaml_config(
+        dict(config.resources_config))
+    hosts_per_node = res.hosts_per_node
+    existing = _read_states(config.cluster_name)
+    instance_ids = []
+    resumed = bool(existing)
+    for i in range(config.num_nodes):
+        node_id = f'node-{i}'
+        instance_ids.append(node_id)
+        state = existing.get(node_id)
+        if state is None or state['status'] in ('TERMINATED',):
+            state = {
+                'status': 'RUNNING',
+                'hosts': hosts_per_node,
+                'created_at': time.time(),
+            }
+        else:
+            state['status'] = 'RUNNING'
+        _write_state(config.cluster_name, node_id, state)
+    return common.ProvisionRecord('local', config.cluster_name, 'local',
+                                  'local', instance_ids, resumed=resumed)
+
+
+def stop_instances(cluster_name: str, region=None, zone=None) -> None:
+    for node_id, state in _read_states(cluster_name).items():
+        state['status'] = 'STOPPED'
+        _write_state(cluster_name, node_id, state)
+
+
+def terminate_instances(cluster_name: str, region=None, zone=None) -> None:
+    cdir = _cluster_dir(cluster_name)
+    if os.path.isdir(cdir):
+        shutil.rmtree(cdir)
+
+
+def wait_instances(cluster_name: str, region=None, zone=None,
+                   timeout_s: float = 1800.0) -> None:
+    statuses = query_instances(cluster_name)
+    bad = {k: v for k, v in statuses.items()
+           if v is not common.InstanceStatus.RUNNING}
+    if bad:
+        raise exceptions.ProvisionError(
+            f'local nodes not running: {bad}')
+
+
+def query_instances(cluster_name: str, region=None,
+                    zone=None) -> Dict[str, common.InstanceStatus]:
+    return {
+        node_id: common.InstanceStatus(state['status'])
+        for node_id, state in _read_states(cluster_name).items()
+    }
+
+
+def get_cluster_info(cluster_name: str, region=None,
+                     zone=None) -> common.ClusterInfo:
+    instances = []
+    for node_id, state in _read_states(cluster_name).items():
+        instances.append(
+            common.InstanceInfo(
+                instance_id=node_id,
+                status=common.InstanceStatus(state['status']),
+                internal_ips=['127.0.0.1'] * int(state.get('hosts', 1)),
+                external_ips=[],
+            ))
+    import getpass
+    return common.ClusterInfo('local', cluster_name, instances,
+                              ssh_user=getpass.getuser())
+
+
+# ----- test helpers (preemption injection) -----------------------------------
+def inject_preemption(cluster_name: str, node_id: str = 'node-0') -> None:
+    """Flip a node to PREEMPTED — the analog of the reference's smoke tests
+    terminating instances mid-job (tests/smoke_tests/test_managed_job.py:355)."""
+    states = _read_states(cluster_name)
+    if node_id not in states:
+        raise exceptions.ClusterDoesNotExistError(
+            f'{cluster_name}/{node_id} not found')
+    states[node_id]['status'] = 'PREEMPTED'
+    _write_state(cluster_name, node_id, states[node_id])

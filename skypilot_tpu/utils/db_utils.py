@@ -1,0 +1,73 @@
+"""Tiny sqlite helper: per-path connection cache, WAL, dict rows.
+
+The reference uses SQLAlchemy over sqlite/Postgres
+(sky/global_user_state.py:22-117); sqlalchemy is not in this environment,
+and sqlite3 + WAL covers the single-host API-server deployment.  The schema
+layer is written against this module so a Postgres backend can be slotted in
+behind the same interface later.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import sqlite3
+import threading
+from typing import Any, Iterator, List, Optional, Tuple
+
+_local = threading.local()
+
+
+def _connect(path: str) -> sqlite3.Connection:
+    conns = getattr(_local, 'conns', None)
+    if conns is None:
+        conns = _local.conns = {}
+    conn = conns.get(path)
+    if conn is None:
+        os.makedirs(os.path.dirname(path) or '.', exist_ok=True)
+        conn = sqlite3.connect(path, timeout=30.0)
+        conn.row_factory = sqlite3.Row
+        conn.execute('PRAGMA journal_mode=WAL')
+        conn.execute('PRAGMA synchronous=NORMAL')
+        conns[path] = conn
+    return conn
+
+
+@contextlib.contextmanager
+def transaction(path: str) -> Iterator[sqlite3.Connection]:
+    conn = _connect(path)
+    try:
+        yield conn
+        conn.commit()
+    except Exception:
+        conn.rollback()
+        raise
+
+
+def execute(path: str, sql: str, params: Tuple = ()) -> None:
+    with transaction(path) as conn:
+        conn.execute(sql, params)
+
+
+def query(path: str, sql: str, params: Tuple = ()) -> List[sqlite3.Row]:
+    return _connect(path).execute(sql, params).fetchall()
+
+
+def query_one(path: str, sql: str,
+              params: Tuple = ()) -> Optional[sqlite3.Row]:
+    rows = query(path, sql, params)
+    return rows[0] if rows else None
+
+
+def ensure_schema(path: str, ddl: List[str]) -> None:
+    with transaction(path) as conn:
+        for stmt in ddl:
+            conn.execute(stmt)
+
+
+def reset_connections_for_tests() -> None:
+    conns = getattr(_local, 'conns', None)
+    if conns:
+        for conn in conns.values():
+            with contextlib.suppress(Exception):
+                conn.close()
+        conns.clear()

@@ -1,0 +1,49 @@
+"""Distributed locks guarding cluster mutation.
+
+Parity: sky/utils/locks.py (FileLock :114 / PostgresLock :163).  Concurrency
+safety in this framework, as in the reference, is lock-based: every
+provision/teardown/status-mutation takes the per-cluster lock
+(cloud_vm_ray_backend.py:3071 `_locked_provision`), and plan staleness is
+handled by re-planning under the lock (sky/execution.py:408-428).
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Iterator
+
+import filelock
+
+from skypilot_tpu import exceptions
+
+
+def _lock_dir() -> str:
+    d = os.path.expanduser(
+        os.environ.get('SKYTPU_LOCK_DIR', '~/.skytpu/locks'))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def cluster_lock_path(cluster_name: str) -> str:
+    return os.path.join(_lock_dir(), f'cluster.{cluster_name}.lock')
+
+
+@contextlib.contextmanager
+def cluster_lock(cluster_name: str,
+                 timeout: float = 600.0) -> Iterator[None]:
+    """Exclusive per-cluster lock; held across provision/teardown."""
+    lock = filelock.FileLock(cluster_lock_path(cluster_name))
+    try:
+        with lock.acquire(timeout=timeout):
+            yield
+    except filelock.Timeout as e:
+        raise exceptions.ClusterNotUpError(
+            f'Cluster {cluster_name!r} is locked by another operation '
+            f'(waited {timeout:.0f}s).') from e
+
+
+@contextlib.contextmanager
+def named_lock(name: str, timeout: float = 60.0) -> Iterator[None]:
+    lock = filelock.FileLock(os.path.join(_lock_dir(), f'{name}.lock'))
+    with lock.acquire(timeout=timeout):
+        yield

@@ -1,0 +1,220 @@
+"""In-process fake of tpu.googleapis.com v2 for provisioner tests.
+
+The analog of the reference's mocked-cloud fixtures (SURVEY.md §4: "a fake
+TPU provisioner (mock tpu.googleapis.com) for gang-provisioning tests").
+Runs a threaded http.server; scriptable per-zone behavior:
+  fake.set_zone_behavior('us-east5-a', 'stockout' | 'quota' | 'ok')
+Nodes transition CREATING → READY after `ready_after` polls; preemption is
+injected with fake.preempt(node_id).
+"""
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict
+
+
+class _State:
+    def __init__(self):
+        self.nodes: Dict[str, dict] = {}            # key: zone/node_id
+        self.queued: Dict[str, dict] = {}           # key: zone/qr_id
+        self.zone_behavior: Dict[str, str] = {}
+        self.polls_to_ready = 0
+        self.lock = threading.Lock()
+
+
+class FakeTpuApi:
+    def __init__(self):
+        self.state = _State()
+        handler = self._make_handler()
+        self.server = ThreadingHTTPServer(('127.0.0.1', 0), handler)
+        self.thread = threading.Thread(target=self.server.serve_forever,
+                                       daemon=True)
+        self.thread.start()
+
+    @property
+    def endpoint(self) -> str:
+        return f'http://127.0.0.1:{self.server.server_port}/v2'
+
+    def close(self):
+        self.server.shutdown()
+
+    # ----- scripting ---------------------------------------------------------
+    def set_zone_behavior(self, zone: str, behavior: str):
+        self.state.zone_behavior[zone] = behavior
+
+    def preempt(self, zone: str, node_id: str):
+        with self.state.lock:
+            self.state.nodes[f'{zone}/{node_id}']['state'] = 'PREEMPTED'
+
+    def node(self, zone: str, node_id: str) -> dict:
+        return self.state.nodes[f'{zone}/{node_id}']
+
+    # ----- handler -----------------------------------------------------------
+    def _make_handler(self):
+        state = self.state
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, code: int, body: dict):
+                blob = json.dumps(body).encode()
+                self.send_response(code)
+                self.send_header('Content-Type', 'application/json')
+                self.send_header('Content-Length', str(len(blob)))
+                self.end_headers()
+                self.wfile.write(blob)
+
+            def _error(self, code: int, message: str):
+                self._send(code, {'error': {'code': code,
+                                            'message': message}})
+
+            def _body(self) -> dict:
+                length = int(self.headers.get('Content-Length', 0) or 0)
+                if not length:
+                    return {}
+                return json.loads(self.rfile.read(length))
+
+            def do_GET(self):
+                path = self.path.split('?')[0]
+                m = re.match(
+                    r'.*/locations/([^/]+)/nodes/?([^/]*)$', path)
+                if m and m.group(2):
+                    zone, node_id = m.group(1), m.group(2)
+                    node = state.nodes.get(f'{zone}/{node_id}')
+                    if node is None:
+                        return self._error(404, 'node not found')
+                    self._maybe_advance(node)
+                    return self._send(200, node)
+                if m:
+                    zone = m.group(1)
+                    nodes = [n for k, n in state.nodes.items()
+                             if k.startswith(f'{zone}/')]
+                    for n in nodes:
+                        self._maybe_advance(n)
+                    return self._send(200, {'nodes': nodes})
+                m = re.match(
+                    r'.*/locations/([^/]+)/queuedResources/([^/]+)$', path)
+                if m:
+                    qr = state.queued.get(f'{m.group(1)}/{m.group(2)}')
+                    if qr is None:
+                        return self._error(404, 'queued resource not found')
+                    self._advance_qr(m.group(1), qr)
+                    return self._send(200, qr)
+                m = re.match(
+                    r'.*/locations/([^/]+)/queuedResources$', path)
+                if m:
+                    zone = m.group(1)
+                    qrs = [q for k, q in state.queued.items()
+                           if k.startswith(f'{zone}/')]
+                    return self._send(200, {'queuedResources': qrs})
+                if '/operations/' in path:
+                    return self._send(200, {'name': path, 'done': True})
+                return self._error(404, f'unknown path {path}')
+
+            def _maybe_advance(self, node: dict):
+                with state.lock:
+                    if node['state'] == 'CREATING':
+                        node['_polls'] = node.get('_polls', 0) + 1
+                        if node['_polls'] > state.polls_to_ready:
+                            node['state'] = 'READY'
+
+            def _advance_qr(self, zone: str, qr: dict):
+                with state.lock:
+                    if qr['state']['state'] == 'WAITING_FOR_RESOURCES':
+                        qr['_polls'] = qr.get('_polls', 0) + 1
+                        if qr['_polls'] > state.polls_to_ready:
+                            qr['state']['state'] = 'ACTIVE'
+                            # materialize the node
+                            spec = qr['tpu']['nodeSpec'][0]
+                            node_id = spec['nodeId']
+                            node = dict(spec['node'])
+                            node['name'] = (f'projects/p/locations/{zone}'
+                                            f'/nodes/{node_id}')
+                            node['state'] = 'READY'
+                            node.setdefault('networkEndpoints', [
+                                {'ipAddress': '10.0.0.1',
+                                 'accessConfig': {'externalIp': '1.2.3.4'}}
+                            ])
+                            state.nodes[f'{zone}/{node_id}'] = node
+
+            def do_POST(self):
+                path = self.path.split('?')[0]
+                query = self.path.split('?')[1] if '?' in self.path else ''
+                m = re.match(r'.*/locations/([^/]+)/nodes$', path)
+                if m:
+                    zone = m.group(1)
+                    behavior = state.zone_behavior.get(zone, 'ok')
+                    if behavior == 'stockout':
+                        return self._error(
+                            429, 'There is no more capacity in the zone; '
+                            'RESOURCE_EXHAUSTED')
+                    if behavior == 'quota':
+                        return self._error(
+                            403, 'Quota exceeded for quota metric '
+                            'TPUV5sPodPerProjectPerZone')
+                    node_id = re.search(r'nodeId=([^&]+)', query).group(1)
+                    body = self._body()
+                    node = dict(body)
+                    node['name'] = (f'projects/p/locations/{zone}'
+                                    f'/nodes/{node_id}')
+                    node['state'] = ('READY' if state.polls_to_ready == 0
+                                     else 'CREATING')
+                    node.setdefault('networkEndpoints', [
+                        {'ipAddress': '10.0.0.1',
+                         'accessConfig': {'externalIp': '1.2.3.4'}}])
+                    with state.lock:
+                        state.nodes[f'{zone}/{node_id}'] = node
+                    return self._send(200, {'name': f'{path}/operations/1',
+                                            'done': True})
+                m = re.match(r'.*/locations/([^/]+)/queuedResources$', path)
+                if m:
+                    zone = m.group(1)
+                    behavior = state.zone_behavior.get(zone, 'ok')
+                    if behavior == 'stockout':
+                        return self._error(429, 'RESOURCE_EXHAUSTED')
+                    if behavior == 'quota':
+                        return self._error(403, 'Quota exceeded')
+                    qr_id = re.search(r'queuedResourceId=([^&]+)',
+                                      query).group(1)
+                    qr = self._body()
+                    qr['name'] = (f'projects/p/locations/{zone}'
+                                  f'/queuedResources/{qr_id}')
+                    qr['state'] = {'state': 'WAITING_FOR_RESOURCES'}
+                    with state.lock:
+                        state.queued[f'{zone}/{qr_id}'] = qr
+                    return self._send(200, {'name': f'{path}/op/1',
+                                            'done': True})
+                m = re.match(
+                    r'.*/locations/([^/]+)/nodes/([^/:]+):(stop|start)$',
+                    path)
+                if m:
+                    zone, node_id, verb = m.groups()
+                    node = state.nodes.get(f'{zone}/{node_id}')
+                    if node is None:
+                        return self._error(404, 'node not found')
+                    with state.lock:
+                        node['state'] = ('STOPPED' if verb == 'stop'
+                                         else 'READY')
+                    return self._send(200, {'name': 'op', 'done': True})
+                return self._error(404, f'unknown POST {path}')
+
+            def do_DELETE(self):
+                path = self.path.split('?')[0]
+                m = re.match(r'.*/locations/([^/]+)/nodes/([^/]+)$', path)
+                if m:
+                    with state.lock:
+                        state.nodes.pop(f'{m.group(1)}/{m.group(2)}', None)
+                    return self._send(200, {'name': 'op', 'done': True})
+                m = re.match(
+                    r'.*/locations/([^/]+)/queuedResources/([^/]+)$', path)
+                if m:
+                    with state.lock:
+                        state.queued.pop(f'{m.group(1)}/{m.group(2)}', None)
+                    return self._send(200, {'name': 'op', 'done': True})
+                return self._error(404, f'unknown DELETE {path}')
+
+        return Handler

@@ -1,0 +1,135 @@
+"""End-to-end launch pipeline on the local cloud: optimize → provision →
+agent bootstrap → gang execute → logs → exec → queue → cancel → down.
+
+The minimum end-to-end slice of SURVEY.md §7 phase 5, hermetic (no cloud).
+"""
+import os
+import time
+
+import pytest
+
+from skypilot_tpu import core
+from skypilot_tpu import execution
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu.agent.job_queue import JobStatus
+from skypilot_tpu.global_user_state import ClusterStatus
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.task import Task
+
+
+@pytest.fixture
+def local_task(tmp_home, enable_all_clouds):
+    def make(run='echo hello-from-skytpu', name='t', **kwargs):
+        t = Task(name, run=run, **kwargs)
+        t.set_resources(Resources.from_yaml_config({'infra': 'local'}))
+        return t
+    return make
+
+
+def _wait_job(cluster, job_id, timeout=30):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        jobs = core.queue(cluster)
+        rec = next(j for j in jobs if j['job_id'] == job_id)
+        if JobStatus(rec['status']).is_terminal():
+            return rec
+        time.sleep(0.3)
+    raise TimeoutError(f'job {job_id} still running')
+
+
+def test_launch_end_to_end(local_task):
+    job_id, handle = execution.launch(local_task(), 'e2e',
+                                      quiet_optimizer=True)
+    assert job_id is not None
+    assert handle.cluster_name == 'e2e'
+    rec = global_user_state.get_cluster('e2e')
+    assert rec['status'] is ClusterStatus.UP
+    job = _wait_job('e2e', job_id)
+    assert JobStatus(job['status']) is JobStatus.SUCCEEDED
+    core.down('e2e')
+    assert global_user_state.get_cluster('e2e') is None
+
+
+def test_launch_reuses_cluster_and_exec(local_task):
+    _, handle1 = execution.launch(local_task(), 'reuse',
+                                  quiet_optimizer=True)
+    job2, handle2 = execution.exec_(local_task(run='echo second'), 'reuse')
+    assert handle2.agent_port == handle1.agent_port
+    job = _wait_job('reuse', job2)
+    assert JobStatus(job['status']) is JobStatus.SUCCEEDED
+    core.down('reuse')
+
+
+def test_setup_and_env_injection(local_task, tmp_home):
+    out_file = tmp_home / 'gang_env.txt'
+    t = local_task(
+        run=f'echo "rank=$SKYTPU_NODE_RANK nodes=$SKYTPU_NUM_NODES '
+            f'coord=$SKYTPU_COORDINATOR_ADDR myenv=$MYVAR" >> {out_file}',
+        name='envtest')
+    t.setup = f'echo setup-ran > {tmp_home}/setup.txt'
+    t.update_envs({'MYVAR': 'hello42'})
+    job_id, _ = execution.launch(t, 'envt', quiet_optimizer=True)
+    _wait_job('envt', job_id)
+    assert (tmp_home / 'setup.txt').read_text().strip() == 'setup-ran'
+    content = out_file.read_text()
+    assert 'rank=0' in content
+    assert 'nodes=1' in content
+    assert 'coord=127.0.0.1:8476' in content
+    assert 'myenv=hello42' in content
+    core.down('envt')
+
+
+def test_failed_job_raises_and_logs(local_task, capsys):
+    t = local_task(run='echo about-to-fail && exit 3', name='failing')
+    with pytest.raises(exceptions.JobExitNonZeroError) as err:
+        execution.launch(t, 'failt', quiet_optimizer=True)
+    assert err.value.returncode == 3
+    captured = capsys.readouterr()
+    assert 'about-to-fail' in captured.out
+    core.down('failt')
+
+
+def test_cancel_running_job(local_task):
+    t = local_task(run='sleep 60', name='sleeper')
+    job_id, _ = execution.launch(t, 'canc', detach_run=True,
+                                 quiet_optimizer=True)
+    # wait for it to start
+    time.sleep(1.5)
+    assert core.cancel('canc', job_id)
+    core.down('canc')
+
+
+def test_workdir_sync(local_task, tmp_home, tmp_path):
+    workdir = tmp_path / 'proj'
+    workdir.mkdir()
+    (workdir / 'data.txt').write_text('payload123')
+    t = Task('wd', run='cat data.txt', workdir=str(workdir))
+    t.set_resources(Resources.from_yaml_config({'infra': 'local'}))
+    job_id, handle = execution.launch(t, 'wdt', detach_run=True,
+                                      quiet_optimizer=True)
+    job = _wait_job('wdt', job_id)
+    assert JobStatus(job['status']) is JobStatus.SUCCEEDED
+    core.down('wdt')
+
+
+def test_exec_on_missing_cluster_raises(local_task):
+    with pytest.raises(exceptions.ClusterDoesNotExistError):
+        execution.exec_(local_task(), 'nope')
+
+
+def test_status_refresh_detects_preemption(local_task):
+    execution.launch(local_task(run=None), 'preem', quiet_optimizer=True)
+    from skypilot_tpu.provision.local import instance as local_instance
+    from skypilot_tpu.backends import backend_utils
+    local_instance.inject_preemption('preem')
+    status = backend_utils.refresh_cluster_status('preem')
+    assert status is ClusterStatus.INIT  # unhealthy
+    core.down('preem')
+
+
+def test_dryrun_no_side_effects(local_task):
+    job_id, handle = execution.launch(local_task(), 'dry', dryrun=True,
+                                      quiet_optimizer=True)
+    assert job_id is None and handle is None
+    assert global_user_state.get_cluster('dry') is None

@@ -1,0 +1,197 @@
+"""State DB, local provisioner, GCP TPU provisioner (fake API), failover."""
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import provision
+from skypilot_tpu.global_user_state import ClusterHandle, ClusterStatus
+from skypilot_tpu.provision import failover
+from skypilot_tpu.provision.common import InstanceStatus, ProvisionConfig
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.task import Task
+
+
+@pytest.fixture
+def fake_tpu(monkeypatch):
+    from tests.fake_tpu_api import FakeTpuApi
+    fake = FakeTpuApi()
+    monkeypatch.setenv('SKYTPU_TPU_API_ENDPOINT', fake.endpoint)
+    monkeypatch.setenv('SKYTPU_GCP_PROJECT', 'test-project')
+    yield fake
+    fake.close()
+
+
+def _tpu_config(cluster='c1', acc='tpu-v5p-8', zone='us-east5-a',
+                spot=False, num_nodes=1):
+    return ProvisionConfig(
+        cluster_name=cluster, num_nodes=num_nodes,
+        resources_config={'accelerators': acc, 'use_spot': spot,
+                          'infra': f'gcp/{zone.rsplit("-", 1)[0]}/{zone}'},
+        region=zone.rsplit('-', 1)[0], zone=zone)
+
+
+# ----- state -----------------------------------------------------------------
+def test_cluster_state_roundtrip(tmp_home):
+    handle = ClusterHandle('c1', 'gcp', 'us-east5', 'us-east5-a',
+                           {'accelerators': 'tpu-v5p-8'}, 1,
+                           [['1.2.3.4']], ['c1-0'])
+    global_user_state.add_or_update_cluster('c1', handle, is_launch=True)
+    rec = global_user_state.get_cluster('c1')
+    assert rec['status'] is ClusterStatus.INIT
+    assert rec['handle'].head_ip == '1.2.3.4'
+    assert rec['handle'].launched_resources().accelerator_name == 'tpu-v5p-8'
+    global_user_state.set_cluster_status('c1', ClusterStatus.UP)
+    assert global_user_state.get_cluster('c1')['status'] is ClusterStatus.UP
+    global_user_state.add_cluster_event('c1', 'provision', 'ok')
+    assert global_user_state.get_cluster_events('c1')
+    global_user_state.remove_cluster('c1')
+    assert global_user_state.get_cluster('c1') is None
+
+
+# ----- local provisioner -----------------------------------------------------
+def test_local_provision_lifecycle(tmp_home):
+    config = ProvisionConfig(cluster_name='loc', num_nodes=2,
+                             resources_config={'infra': 'local'},
+                             region='local', zone='local')
+    record = provision.run_instances('local', config)
+    assert record.instance_ids == ['node-0', 'node-1']
+    provision.wait_instances('local', 'loc')
+    info = provision.get_cluster_info('local', 'loc')
+    assert len(info.instances) == 2
+    assert info.head_ip == '127.0.0.1'
+    provision.stop_instances('local', 'loc')
+    statuses = provision.query_instances('local', 'loc')
+    assert all(s is InstanceStatus.STOPPED for s in statuses.values())
+    provision.terminate_instances('local', 'loc')
+    assert provision.query_instances('local', 'loc') == {}
+
+
+def test_local_simulated_tpu_pod_fanout(tmp_home):
+    config = ProvisionConfig(cluster_name='pod', num_nodes=1,
+                             resources_config={'accelerators': 'tpu-v5p-16',
+                                               'infra': 'local'},
+                             region='local', zone='local')
+    provision.run_instances('local', config)
+    info = provision.get_cluster_info('local', 'pod')
+    # v5p-16 = 8 chips = 2 hosts
+    assert len(info.node_ips[0]) == 2
+
+
+def test_local_preemption_injection(tmp_home):
+    config = ProvisionConfig(cluster_name='pre', num_nodes=1,
+                             resources_config={'infra': 'local'},
+                             region='local', zone='local')
+    provision.run_instances('local', config)
+    from skypilot_tpu.provision.local import instance as local_instance
+    local_instance.inject_preemption('pre')
+    statuses = provision.query_instances('local', 'pre')
+    assert statuses['node-0'] is InstanceStatus.PREEMPTED
+
+
+# ----- GCP TPU provisioner (fake API) ---------------------------------------
+def test_gcp_tpu_direct_create(fake_tpu):
+    record = provision.run_instances('gcp', _tpu_config())
+    assert record.instance_ids == ['c1-0']
+    provision.wait_instances('gcp', 'c1', zone='us-east5-a', timeout_s=30)
+    info = provision.get_cluster_info('gcp', 'c1', zone='us-east5-a')
+    assert info.instances[0].external_ips == ['1.2.3.4']
+    node = fake_tpu.node('us-east5-a', 'c1-0')
+    assert node['acceleratorType'] == 'v5p-8'
+    assert node['runtimeVersion'] == 'v2-alpha-tpuv5'
+    assert node['labels']['skytpu-cluster'] == 'c1'
+    provision.terminate_instances('gcp', 'c1', zone='us-east5-a')
+    assert provision.query_instances('gcp', 'c1', zone='us-east5-a') == {}
+
+
+def test_gcp_tpu_spot_uses_queued_resources(fake_tpu):
+    record = provision.run_instances(
+        'gcp', _tpu_config(cluster='spotc', spot=True))
+    assert record.instance_ids == ['spotc-0']
+    # queued resource parked; polls flip it ACTIVE and materialize the node
+    provision.wait_instances('gcp', 'spotc', zone='us-east5-a',
+                             timeout_s=60)
+    statuses = provision.query_instances('gcp', 'spotc', zone='us-east5-a')
+    assert statuses['spotc-0'] is InstanceStatus.RUNNING
+
+
+def test_gcp_tpu_pod_cannot_stop(fake_tpu):
+    provision.run_instances('gcp', _tpu_config(cluster='podc',
+                                               acc='tpu-v5p-16'))
+    provision.wait_instances('gcp', 'podc', zone='us-east5-a', timeout_s=30)
+    with pytest.raises(exceptions.NotSupportedError):
+        provision.stop_instances('gcp', 'podc', zone='us-east5-a')
+
+
+def test_gcp_preempted_node_recreated(fake_tpu):
+    provision.run_instances('gcp', _tpu_config(cluster='pr'))
+    provision.wait_instances('gcp', 'pr', zone='us-east5-a', timeout_s=30)
+    fake_tpu.preempt('us-east5-a', 'pr-0')
+    statuses = provision.query_instances('gcp', 'pr', zone='us-east5-a')
+    assert statuses['pr-0'] is InstanceStatus.PREEMPTED
+    # re-running provisions a fresh node (stale spot node deleted first,
+    # reference gcp.py:1095-1101 semantics)
+    provision.run_instances('gcp', _tpu_config(cluster='pr'))
+    statuses = provision.query_instances('gcp', 'pr', zone='us-east5-a')
+    assert statuses['pr-0'] is InstanceStatus.RUNNING
+
+
+def test_gcp_stockout_classified(fake_tpu):
+    fake_tpu.set_zone_behavior('us-east5-a', 'stockout')
+    with pytest.raises(exceptions.InsufficientCapacityError):
+        provision.run_instances('gcp', _tpu_config())
+    fake_tpu.set_zone_behavior('us-east5-a', 'quota')
+    with pytest.raises(exceptions.QuotaExceededError):
+        provision.run_instances('gcp', _tpu_config(cluster='c2'))
+
+
+# ----- failover engine -------------------------------------------------------
+def _mk_tpu_task(acc='tpu-v6e-8'):
+    t = Task('train', run='echo hi')
+    t.set_resources(Resources.from_yaml_config(
+        {'accelerators': acc, 'infra': 'gcp'}))
+    return t
+
+
+def test_failover_moves_to_next_zone(enable_all_clouds):
+    attempts = []
+
+    def provision_fn(candidate):
+        attempts.append((candidate.region, candidate.zone))
+        if len(attempts) < 3:
+            raise exceptions.InsufficientCapacityError('stockout')
+        from skypilot_tpu.provision.common import ProvisionRecord
+        return ProvisionRecord('gcp', 'c', candidate.region, candidate.zone,
+                               ['c-0'])
+
+    result = failover.provision_with_retries(_mk_tpu_task(), 'c',
+                                             provision_fn)
+    assert len(attempts) == 3
+    # each attempt hit a distinct zone
+    assert len(set(attempts)) == 3
+    assert result.record.zone == attempts[-1][1]
+
+
+def test_failover_quota_blocks_whole_region(enable_all_clouds):
+    attempts = []
+
+    def provision_fn(candidate):
+        attempts.append((candidate.region, candidate.zone))
+        raise exceptions.QuotaExceededError('quota')
+
+    with pytest.raises(exceptions.ResourcesUnavailableError) as err:
+        failover.provision_with_retries(_mk_tpu_task('tpu-v2-8'), 'c',
+                                        provision_fn)
+    # v2 has 3 zones in us-central1 but quota blocklists regions: one
+    # attempt per *region* (us-central1, europe-west4, asia-east1).
+    regions = [r for r, _ in attempts]
+    assert len(regions) == len(set(regions))
+    assert err.value.failover_history
+
+
+def test_failover_exhaustion_reports_history(enable_all_clouds):
+    def provision_fn(candidate):
+        raise exceptions.InsufficientCapacityError('stockout everywhere')
+
+    with pytest.raises(exceptions.ResourcesUnavailableError) as err:
+        failover.provision_with_retries(_mk_tpu_task(), 'c', provision_fn)
+    assert 'Failover history' in str(err.value)
