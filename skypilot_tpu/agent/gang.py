@@ -101,16 +101,22 @@ class GangJob:
             procs.append(runner.popen(full_cmd, env=env,
                                       log_path=log_path))
         self._procs = procs
-        # Monitor loop: cancellable, and any host's failure is terminal.
+        # Monitor loop: cancellable, and any host's failure is terminal —
+        # surviving ranks are killed immediately (a dead host wedges the
+        # ICI mesh; peers would otherwise block in collectives forever).
         import time
         while True:
             if self._cancelled:
                 self._kill_all()
                 return 130
             rcs = [p.poll() for p in procs]
+            first_bad = next(
+                (rc for rc in rcs if rc is not None and rc != 0), None)
+            if first_bad is not None:
+                self._kill_all()
+                return first_bad
             if all(rc is not None for rc in rcs):
-                # Any non-zero (incl. negative signal codes) fails the gang.
-                return next((rc for rc in rcs if rc != 0), 0)
+                return 0
             time.sleep(0.2)
 
     def _kill_all(self) -> None:

@@ -135,9 +135,15 @@ def make_app(scheduler: Optional[AgentScheduler] = None) -> web.Application:
         return web.json_response({'cancelled': ok})
 
     async def logs(request):
+        import re
         job_id = int(request.match_info['job_id'])
         phase = request.query.get('phase', 'run')
         rank = request.query.get('rank', '0')
+        # Path components: reject traversal attempts outright.
+        if not re.fullmatch(r'[A-Za-z0-9_-]+', phase) or \
+                not re.fullmatch(r'[0-9]+', rank):
+            return web.json_response({'error': 'bad phase/rank'},
+                                     status=400)
         offset = int(request.query.get('offset', '0'))
         path = os.path.join(job_queue.log_dir(job_id),
                             f'{phase}-{rank}.log')

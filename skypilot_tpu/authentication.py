@@ -1,0 +1,33 @@
+"""SSH keypair management (parity: sky/authentication.py).
+
+One framework keypair (`~/.ssh/sky-key`) generated on first use; its public
+key is injected into every provisioned host via instance metadata, and the
+backend's SSH runners authenticate with the private half.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+from typing import Tuple
+
+from skypilot_tpu import exceptions
+
+PRIVATE_KEY_PATH = '~/.ssh/sky-key'
+PUBLIC_KEY_PATH = '~/.ssh/sky-key.pub'
+
+
+def get_or_generate_keys() -> Tuple[str, str]:
+    """Returns (private_key_path, public_key_str), generating if needed."""
+    priv = os.path.expanduser(PRIVATE_KEY_PATH)
+    pub = os.path.expanduser(PUBLIC_KEY_PATH)
+    if not os.path.exists(priv):
+        os.makedirs(os.path.dirname(priv), mode=0o700, exist_ok=True)
+        proc = subprocess.run(
+            ['ssh-keygen', '-t', 'ed25519', '-N', '', '-q', '-f', priv,
+             '-C', 'skytpu'],
+            capture_output=True, check=False)
+        if proc.returncode != 0:
+            raise exceptions.SkyTpuError(
+                f'ssh-keygen failed: {proc.stderr.decode()}')
+    with open(pub, encoding='utf-8') as f:
+        return priv, f.read().strip()

@@ -103,12 +103,17 @@ class TpuVmBackend(backend_lib.Backend):
     def _provision_locked(self, task: task_lib.Task,
                           cluster_name: str) -> ClusterHandle:
         def provision_fn(candidate: resources_lib.Resources):
+            authorized_key = None
+            if candidate.cloud != 'local':
+                from skypilot_tpu import authentication
+                _, authorized_key = authentication.get_or_generate_keys()
             config = ProvisionConfig(
                 cluster_name=cluster_name,
                 num_nodes=task.num_nodes,
                 resources_config=candidate.to_yaml_config(),
                 region=candidate.region,
                 zone=candidate.zone,
+                authorized_key=authorized_key,
                 labels=candidate.labels or {},
                 ports=candidate.ports or [],
             )
@@ -118,10 +123,19 @@ class TpuVmBackend(backend_lib.Backend):
                                          zone=record.zone)
             return record
 
+        def cleanup_fn(candidate: resources_lib.Resources):
+            # Delete partial nodes / parked queued-resources in the failed
+            # zone before failing over elsewhere.
+            provision_lib.terminate_instances(candidate.cloud,
+                                              cluster_name,
+                                              region=candidate.region,
+                                              zone=candidate.zone)
+
         global_user_state.add_cluster_event(cluster_name, 'provision_start',
                                             '')
         result = failover.provision_with_retries(task, cluster_name,
-                                                 provision_fn)
+                                                 provision_fn,
+                                                 cleanup_fn=cleanup_fn)
         candidate = result.resources
         info = provision_lib.get_cluster_info(candidate.cloud, cluster_name,
                                               region=result.record.region,

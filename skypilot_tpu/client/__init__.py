@@ -1,0 +1,1 @@
+"""Client layer: REST SDK + CLI (parity: sky/client/)."""

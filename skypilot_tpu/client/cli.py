@@ -1,0 +1,252 @@
+"""`skytpu` CLI (parity: sky/client/cli/command.py — launch :1040,
+exec :1231, status/stop/down/logs/queue/cancel/autostop/check).
+
+Thin click layer over the REST SDK; all real work happens server-side.
+Run as `python -m skypilot_tpu.client.cli` or the `skytpu` entry point.
+"""
+from __future__ import annotations
+
+import sys
+from typing import Optional, Tuple
+
+import click
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.client import sdk
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import ux_utils
+
+
+def _load_task(entrypoint: Tuple[str, ...], **overrides) -> task_lib.Task:
+    """YAML file or inline command → Task (reference:
+    _make_task_or_dag_from_entrypoint_with_overrides, command.py:731)."""
+    if len(entrypoint) == 1 and entrypoint[0].endswith(
+            ('.yaml', '.yml')):
+        task = task_lib.Task.from_yaml(entrypoint[0])
+    else:
+        task = task_lib.Task(run=' '.join(entrypoint) or None)
+    res_overrides = {
+        k: v for k, v in overrides.items()
+        if k in ('accelerators', 'infra', 'cpus', 'memory', 'use_spot')
+        and v not in (None, False)
+    }
+    if res_overrides:
+        task.set_resources(
+            {r.copy(**res_overrides) for r in task.resources})
+    if overrides.get('num_nodes'):
+        task.num_nodes = overrides['num_nodes']
+    if overrides.get('workdir'):
+        task.workdir = overrides['workdir']
+    if overrides.get('name'):
+        task.name = overrides['name']
+    return task
+
+
+@click.group()
+@click.version_option('0.1.0', prog_name='skytpu')
+def cli() -> None:
+    """skytpu — run AI workloads on TPU infrastructure."""
+
+
+_task_options = [
+    click.option('--cluster', '-c', default=None, help='Cluster name.'),
+    click.option('--name', '-n', default=None, help='Task name.'),
+    click.option('--accelerators', '--gpus', 'accelerators', default=None,
+                 help='e.g. tpu-v5p-8'),
+    click.option('--infra', default=None, help='cloud[/region[/zone]]'),
+    click.option('--cpus', default=None),
+    click.option('--memory', default=None),
+    click.option('--num-nodes', type=int, default=None),
+    click.option('--use-spot', is_flag=True, default=False),
+    click.option('--workdir', default=None),
+    click.option('--detach-run', '-d', is_flag=True, default=False),
+]
+
+
+def _apply(options):
+    def wrap(fn):
+        for opt in reversed(options):
+            fn = opt(fn)
+        return fn
+    return wrap
+
+
+@cli.command()
+@click.argument('entrypoint', nargs=-1)
+@_apply(_task_options)
+@click.option('--dryrun', is_flag=True, default=False)
+def launch(entrypoint, cluster, detach_run, dryrun, **overrides):
+    """Launch a task on a new or existing cluster."""
+    task = _load_task(entrypoint, **overrides)
+    cluster = cluster or f'sky-{common_utils.generate_id(length=4)}'
+    request_id = sdk.launch(task, cluster, dryrun=dryrun)
+    click.echo(f'Launch request {request_id} submitted '
+               f'(cluster {cluster!r}).')
+    result = sdk.get(request_id)
+    if dryrun or result.get('job_id') is None:
+        return
+    click.echo(f'Job {result["job_id"]} on cluster {cluster!r}.')
+    if not detach_run:
+        sdk.tail_logs(cluster, result['job_id'])
+
+
+@cli.command('exec')
+@click.argument('entrypoint', nargs=-1)
+@_apply(_task_options)
+def exec_cmd(entrypoint, cluster, detach_run, **overrides):
+    """Run a task on an existing cluster (skips provision/setup)."""
+    if cluster is None:
+        raise click.UsageError('exec requires --cluster.')
+    task = _load_task(entrypoint, **overrides)
+    result = sdk.get(sdk.exec_(task, cluster))
+    click.echo(f'Job {result["job_id"]} on cluster {cluster!r}.')
+    if not detach_run:
+        sdk.tail_logs(cluster, result['job_id'])
+
+
+@cli.command()
+@click.argument('clusters', nargs=-1)
+@click.option('--refresh', '-r', is_flag=True, default=False)
+def status(clusters, refresh):
+    """Show clusters."""
+    records = sdk.status(list(clusters) or None, refresh=refresh)
+    rows = []
+    for r in records:
+        res = r.get('resources', {})
+        rows.append([
+            r['name'], r['status'],
+            res.get('accelerators') or res.get('instance_type') or 'cpu',
+            res.get('infra', '-'),
+            common_utils.readable_time_duration(
+                max(0, __import__('time').time() - r['launched_at'])),
+        ])
+    ux_utils.print_table(['NAME', 'STATUS', 'RESOURCES', 'INFRA', 'AGE'],
+                         rows)
+
+
+@cli.command()
+@click.argument('cluster')
+@click.option('--yes', '-y', is_flag=True, default=False)
+def down(cluster, yes):
+    """Tear down a cluster."""
+    if not yes:
+        click.confirm(f'Down cluster {cluster!r}?', abort=True)
+    sdk.get(sdk.down(cluster))
+    click.echo(f'Cluster {cluster!r} terminated.')
+
+
+@cli.command()
+@click.argument('cluster')
+def stop(cluster):
+    """Stop a cluster (not supported for TPU pod slices)."""
+    sdk.get(sdk.stop(cluster))
+    click.echo(f'Cluster {cluster!r} stopped.')
+
+
+@cli.command()
+@click.argument('cluster')
+def start(cluster):
+    """Restart a stopped cluster."""
+    sdk.get(sdk.start(cluster))
+    click.echo(f'Cluster {cluster!r} started.')
+
+
+@cli.command()
+@click.argument('cluster')
+@click.option('--idle-minutes', '-i', type=int, default=5)
+@click.option('--down', 'down_flag', is_flag=True, default=False)
+def autostop(cluster, idle_minutes, down_flag):
+    """Schedule autostop/autodown after idleness."""
+    sdk.get(sdk.autostop(cluster, idle_minutes, down_flag))
+    click.echo(f'Autostop set on {cluster!r}: {idle_minutes}m '
+               f'({"down" if down_flag else "stop"}).')
+
+
+@cli.command()
+@click.argument('cluster')
+def queue(cluster):
+    """Show a cluster's job queue."""
+    jobs = sdk.queue(cluster)
+    rows = [[j['job_id'], j.get('name') or '-', j['status'],
+             j.get('returncode') if j.get('returncode') is not None
+             else '-'] for j in jobs]
+    ux_utils.print_table(['ID', 'NAME', 'STATUS', 'RC'], rows)
+
+
+@cli.command()
+@click.argument('cluster')
+@click.argument('job_id', type=int)
+def cancel(cluster, job_id):
+    """Cancel a job."""
+    ok = sdk.cancel(cluster, job_id)
+    click.echo('Cancelled.' if ok else 'Nothing to cancel.')
+
+
+@cli.command()
+@click.argument('cluster')
+@click.argument('job_id', type=int)
+@click.option('--no-follow', is_flag=True, default=False)
+def logs(cluster, job_id, no_follow):
+    """Tail a job's logs."""
+    sdk.tail_logs(cluster, job_id, follow=not no_follow)
+
+
+@cli.command('cost-report')
+def cost_report():
+    """Estimated costs of live clusters."""
+    rows = [[r['name'], str(r['status']),
+             f"${r['hourly_cost']:.2f}", f"${r['accrued_cost']:.2f}"]
+            for r in sdk.cost_report()]
+    ux_utils.print_table(['NAME', 'STATUS', '$/HR', 'ACCRUED'], rows)
+
+
+@cli.command()
+@click.argument('name_filter', required=False)
+def accelerators(name_filter):
+    """List TPU offerings (name, zones, $/hr)."""
+    rows = []
+    for name, offs in sdk.accelerators(name_filter).items():
+        for o in offs:
+            rows.append([name, o['zone'], f"${o['hourly_cost']:.2f}",
+                         f"${o['hourly_cost_spot']:.2f}"])
+    ux_utils.print_table(['ACCELERATOR', 'ZONE', '$/HR', 'SPOT $/HR'],
+                         rows)
+
+
+@cli.command()
+def check():
+    """Check cloud credentials."""
+    for name, info in sdk.check().items():
+        mark = 'enabled' if info['enabled'] else \
+            f'disabled ({info["reason"]})'
+        click.echo(f'  {name}: {mark}')
+
+
+@cli.group()
+def api():
+    """API server management."""
+
+
+@api.command('start')
+def api_start():
+    sdk.ensure_server_running()
+    click.echo(f'API server running at {sdk.server_url()}.')
+
+
+@api.command('info')
+def api_info_cmd():
+    info = sdk.api_info()
+    click.echo(info if info else 'API server not running.')
+
+
+def main() -> None:
+    try:
+        cli()
+    except exceptions.SkyTpuError as e:
+        click.echo(f'Error: {e}', err=True)
+        sys.exit(1)
+
+
+if __name__ == '__main__':
+    main()

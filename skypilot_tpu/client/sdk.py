@@ -1,0 +1,175 @@
+"""Python SDK speaking REST to the API server (parity: sky/client/sdk.py).
+
+Every mutating call returns a request id; `get(request_id)` blocks until
+completion (the reference's `stream_and_get`).  If no server is reachable
+the SDK auto-starts one locally (the reference does the same on first use).
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+import requests as requests_lib
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import task as task_lib
+
+DEFAULT_SERVER = 'http://127.0.0.1:8700'
+
+
+def server_url() -> str:
+    return os.environ.get('SKYTPU_API_SERVER', DEFAULT_SERVER).rstrip('/')
+
+
+def api_info(timeout: float = 2.0) -> Optional[Dict[str, Any]]:
+    try:
+        resp = requests_lib.get(f'{server_url()}/api/health',
+                                timeout=timeout)
+        return resp.json()
+    except requests_lib.RequestException:
+        return None
+
+
+def ensure_server_running(timeout_s: float = 30.0) -> None:
+    if api_info() is not None:
+        return
+    url = server_url()
+    if '127.0.0.1' not in url and 'localhost' not in url:
+        raise exceptions.ApiServerError(
+            f'API server {url} unreachable and not local — cannot '
+            'auto-start it.')
+    port = url.rsplit(':', 1)[-1]
+    env = dict(os.environ)
+    import skypilot_tpu
+    pkg_parent = os.path.dirname(os.path.dirname(
+        os.path.abspath(skypilot_tpu.__file__)))
+    env['PYTHONPATH'] = (pkg_parent + os.pathsep +
+                         env.get('PYTHONPATH', '')).rstrip(os.pathsep)
+    subprocess.Popen(
+        [sys.executable, '-m', 'skypilot_tpu.server.app', '--port', port],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        start_new_session=True)
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if api_info() is not None:
+            return
+        time.sleep(0.5)
+    raise exceptions.ApiServerError('API server failed to start.')
+
+
+def _post(path: str, body: Dict[str, Any]) -> Dict[str, Any]:
+    ensure_server_running()
+    resp = requests_lib.post(f'{server_url()}{path}', json=body,
+                             timeout=60)
+    if resp.status_code >= 400:
+        raise exceptions.ApiServerError(
+            f'{path} failed ({resp.status_code}): {resp.text}')
+    return resp.json()
+
+
+def _get(path: str, **params) -> Any:
+    ensure_server_running()
+    resp = requests_lib.get(f'{server_url()}{path}', params=params,
+                            timeout=60)
+    if resp.status_code >= 400:
+        raise exceptions.ApiServerError(
+            f'{path} failed ({resp.status_code}): {resp.text}')
+    return resp.json()
+
+
+def get(request_id: str, timeout_s: float = 3600.0) -> Any:
+    """Block until the request finishes; return its result or raise."""
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        rec = _get(f'/requests/{request_id}')
+        status = rec['status']
+        if status == 'SUCCEEDED':
+            return rec['result']
+        if status == 'FAILED':
+            raise exceptions.ApiServerError(
+                rec.get('error') or 'request failed')
+        if status == 'CANCELLED':
+            raise exceptions.RequestCancelledError(request_id)
+        time.sleep(0.5)
+    raise exceptions.ApiServerError(f'request {request_id} timed out')
+
+
+# ----- operations ------------------------------------------------------------
+def launch(task: task_lib.Task, cluster_name: Optional[str] = None,
+           dryrun: bool = False) -> str:
+    return _post('/launch', {
+        'task': task.to_yaml_config(),
+        'cluster_name': cluster_name,
+        'dryrun': dryrun,
+    })['request_id']
+
+
+def exec_(task: task_lib.Task, cluster_name: str) -> str:
+    return _post('/exec', {'task': task.to_yaml_config(),
+                           'cluster_name': cluster_name})['request_id']
+
+
+def status(cluster_names: Optional[List[str]] = None,
+           refresh: bool = False) -> List[Dict[str, Any]]:
+    params: Dict[str, Any] = {'refresh': '1' if refresh else '0'}
+    if cluster_names:
+        params['cluster'] = cluster_names
+    return _get('/status', **params)
+
+
+def down(cluster_name: str) -> str:
+    return _post('/down', {'cluster_name': cluster_name})['request_id']
+
+
+def stop(cluster_name: str) -> str:
+    return _post('/stop', {'cluster_name': cluster_name})['request_id']
+
+
+def start(cluster_name: str) -> str:
+    return _post('/start', {'cluster_name': cluster_name})['request_id']
+
+
+def autostop(cluster_name: str, idle_minutes: int,
+             down_flag: bool = False) -> str:
+    return _post('/autostop', {'cluster_name': cluster_name,
+                               'idle_minutes': idle_minutes,
+                               'down': down_flag})['request_id']
+
+
+def queue(cluster_name: str) -> List[Dict[str, Any]]:
+    return _get(f'/queue/{cluster_name}')
+
+
+def cancel(cluster_name: str, job_id: int) -> bool:
+    return _post('/cancel', {'cluster_name': cluster_name,
+                             'job_id': job_id})['cancelled']
+
+
+def tail_logs(cluster_name: str, job_id: int, follow: bool = True,
+              out=None) -> None:
+    """Stream logs through the server."""
+    ensure_server_running()
+    out = out or sys.stdout
+    resp = requests_lib.get(
+        f'{server_url()}/logs/{cluster_name}/{job_id}',
+        params={'follow': '1' if follow else '0'}, stream=True,
+        timeout=None)
+    for chunk in resp.iter_content(chunk_size=None):
+        out.write(chunk.decode(errors='replace'))
+        out.flush()
+
+
+def cost_report() -> List[Dict[str, Any]]:
+    return _get('/cost_report')
+
+
+def accelerators(name_filter: Optional[str] = None) -> Dict[str, Any]:
+    params = {'filter': name_filter} if name_filter else {}
+    return _get('/accelerators', **params)
+
+
+def check() -> Dict[str, Any]:
+    return _get('/check')

@@ -48,6 +48,7 @@ def provision_with_retries(
                            provision_common.ProvisionRecord],
     max_attempts: int = 16,
     blocked_resources: Optional[List[resources_lib.Resources]] = None,
+    cleanup_fn: Optional[Callable[[resources_lib.Resources], None]] = None,
 ) -> ProvisionAttemptResult:
     """Try placements until one provisions.
 
@@ -55,6 +56,10 @@ def provision_with_retries(
     failure; its `blocklist_region` attribute chooses the blocklist scope.
     The task is re-optimized (cheapest surviving placement) between
     attempts — the reference does the same full re-plan per retry round.
+    cleanup_fn(candidate) runs after every failed attempt so partially-
+    provisioned nodes / parked queued-resources in the failed zone are
+    deleted before failing over (otherwise a later-ACTIVE queued resource
+    materializes a billed slice no teardown path can reach).
     """
     blocked: List[resources_lib.Resources] = list(blocked_resources or [])
     history: List[Exception] = []
@@ -76,6 +81,13 @@ def provision_with_retries(
             return ProvisionAttemptResult(record, candidate)
         except exceptions.ProvisionError as e:
             history.append(e)
+            if cleanup_fn is not None:
+                try:
+                    cleanup_fn(candidate)
+                except Exception as cleanup_err:  # pylint: disable=broad-except
+                    logger.warning(
+                        f'cleanup after failed attempt in '
+                        f'{candidate.zone} failed: {cleanup_err}')
             entry = _blocklist_entry(candidate, e.blocklist_region)
             blocked.append(entry)
             scope = 'region' if e.blocklist_region else 'zone'

@@ -90,6 +90,11 @@ def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
         if state == 'READY':
             resumed = True
             continue
+        if state in ('CREATING', 'STARTING', 'RESTARTING', 'REPAIRING'):
+            # In-flight from an interrupted launch: re-creating would 409
+            # and blocklist a healthy zone; wait_instances will pick it up.
+            resumed = True
+            continue
         if state in ('STOPPED', 'STOPPING'):
             client.start_node(zone, node_id)
             resumed = True

@@ -224,6 +224,7 @@ class TpuClient:
         failover engine can blocklist and move on."""
         backoff = common_utils.Backoff(initial=2.0, cap=30.0)
         deadline = time.time() + timeout_s
+        state = 'UNKNOWN'
         while time.time() < deadline:
             qr = self.get_queued_resource(zone, qr_id)
             state = qr.get('state', {}).get('state', 'UNKNOWN')

@@ -48,7 +48,13 @@ def _read_states(cluster_name: str) -> Dict[str, dict]:
     out = {}
     if not os.path.isdir(cdir):
         return out
-    for node_id in sorted(os.listdir(cdir)):
+    # Numeric order ('node-10' after 'node-2'): rank assignment and head
+    # selection derive from this ordering.
+    def _key(node_id: str):
+        suffix = node_id.rsplit('-', 1)[-1]
+        return (int(suffix) if suffix.isdigit() else 1 << 30, node_id)
+
+    for node_id in sorted(os.listdir(cdir), key=_key):
         path = _node_state_path(cluster_name, node_id)
         if os.path.exists(path):
             with open(path, encoding='utf-8') as f:

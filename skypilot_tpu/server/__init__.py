@@ -1,0 +1,2 @@
+"""API server (parity: sky/server/ — FastAPI app at server.py:622,
+LONG/SHORT request executor, persisted+resumable requests DB)."""

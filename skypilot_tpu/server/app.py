@@ -1,0 +1,233 @@
+"""REST API server (parity: sky/server/server.py FastAPI app).
+
+aiohttp (fastapi is not in this environment).  Mutating calls return a
+request id immediately; `GET /requests/{id}` polls; `GET /logs/...`
+streams.  Run: python -m skypilot_tpu.server.app --port 8700
+"""
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+from typing import Any, Dict
+
+from aiohttp import web
+
+from skypilot_tpu import core
+from skypilot_tpu import execution
+from skypilot_tpu import sky_logging
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.server import requests_db
+from skypilot_tpu.server.executor import RequestExecutor
+
+logger = sky_logging.init_logger(__name__)
+API_VERSION = 1
+
+
+def _record_json(record: Dict[str, Any]) -> Dict[str, Any]:
+    out = dict(record)
+    out['status'] = record['status'].value
+    out['handle'] = dataclasses.asdict(record['handle'])
+    return out
+
+
+def make_app() -> web.Application:
+    app = web.Application()
+    executor = RequestExecutor()
+    app['executor'] = executor
+
+    async def on_cleanup(app):
+        executor.shutdown()
+
+    app.on_cleanup.append(on_cleanup)
+
+    # ----- health / meta -----------------------------------------------------
+    async def health(request):
+        return web.json_response({'status': 'healthy',
+                                  'api_version': API_VERSION})
+
+    # ----- requests ----------------------------------------------------------
+    async def get_request(request):
+        rec = requests_db.get(request.match_info['request_id'])
+        if rec is None:
+            return web.json_response({'error': 'not found'}, status=404)
+        out = dict(rec)
+        out['status'] = rec['status'].value
+        return web.json_response(out, dumps=lambda o: json.dumps(
+            o, default=str))
+
+    async def list_requests(request):
+        out = []
+        for rec in requests_db.list_requests():
+            r = dict(rec)
+            r['status'] = rec['status'].value
+            out.append(r)
+        return web.json_response(out, dumps=lambda o: json.dumps(
+            o, default=str))
+
+    # ----- cluster lifecycle -------------------------------------------------
+    async def launch(request):
+        body = await request.json()
+        task = task_lib.Task.from_yaml_config(body['task'])
+        cluster_name = body.get('cluster_name')
+
+        def work():
+            job_id, handle = execution.launch(
+                task, cluster_name, detach_run=True, quiet_optimizer=True,
+                dryrun=body.get('dryrun', False))
+            return {
+                'job_id': job_id,
+                'cluster_name': handle.cluster_name if handle else None,
+            }
+
+        request_id = request.app['executor'].submit('launch', body, work)
+        return web.json_response({'request_id': request_id})
+
+    async def exec_(request):
+        body = await request.json()
+        task = task_lib.Task.from_yaml_config(body['task'])
+        cluster_name = body['cluster_name']
+
+        def work():
+            job_id, handle = execution.exec_(task, cluster_name,
+                                             detach_run=True)
+            return {'job_id': job_id, 'cluster_name': handle.cluster_name}
+
+        request_id = request.app['executor'].submit('exec', body, work)
+        return web.json_response({'request_id': request_id})
+
+    async def status(request):
+        names = request.query.getall('cluster', []) or None
+        refresh = request.query.get('refresh', '0') == '1'
+        records = await asyncio.get_event_loop().run_in_executor(
+            None, lambda: core.status(names, refresh=refresh))
+        return web.json_response([_record_json(r) for r in records])
+
+    def _cluster_op(name: str, fn, long: bool = True):
+        async def handler(request):
+            body = await request.json()
+            cluster = body['cluster_name']
+            request_id = request.app['executor'].submit(
+                name, body, lambda: fn(body, cluster), long=long)
+            return web.json_response({'request_id': request_id})
+        return handler
+
+    down = _cluster_op('down', lambda b, c: core.down(c))
+    stop = _cluster_op('stop', lambda b, c: core.stop(c))
+    start = _cluster_op('start', lambda b, c: core.start(c))
+    autostop = _cluster_op(
+        'autostop',
+        lambda b, c: core.autostop(c, int(b.get('idle_minutes', 5)),
+                                   bool(b.get('down', False))),
+        long=False)
+
+    async def queue(request):
+        cluster = request.match_info['cluster_name']
+        jobs = await asyncio.get_event_loop().run_in_executor(
+            None, lambda: core.queue(cluster))
+        return web.json_response(jobs)
+
+    async def cancel(request):
+        body = await request.json()
+        cluster = body['cluster_name']
+        job_id = int(body['job_id'])
+        ok = await asyncio.get_event_loop().run_in_executor(
+            None, lambda: core.cancel(cluster, job_id))
+        return web.json_response({'cancelled': ok})
+
+    async def logs(request):
+        """Chunked log streaming: server tails the cluster agent and
+        relays (reference: CLI ← server ← cluster tail,
+        cloud_vm_ray_backend.py:4357)."""
+        cluster = request.match_info['cluster_name']
+        job_id = int(request.match_info['job_id'])
+        follow = request.query.get('follow', '1') == '1'
+        record = core._get_handle(cluster)  # pylint: disable=protected-access
+        from skypilot_tpu.backends import TpuVmBackend
+        backend = TpuVmBackend()
+        client = backend._agent_client(record['handle'])  # pylint: disable=protected-access
+        resp = web.StreamResponse()
+        resp.headers['Content-Type'] = 'text/plain'
+        await resp.prepare(request)
+        loop = asyncio.get_event_loop()
+        try:
+            offset = 0
+            while True:
+                chunk = await loop.run_in_executor(
+                    None, lambda: client.read_logs(job_id, offset=offset))
+                if chunk:
+                    offset += len(chunk)
+                    await resp.write(chunk)
+                job = await loop.run_in_executor(
+                    None, lambda: client.get_job(job_id))
+                from skypilot_tpu.agent.job_queue import JobStatus
+                if job is None or JobStatus(job['status']).is_terminal():
+                    chunk = await loop.run_in_executor(
+                        None,
+                        lambda: client.read_logs(job_id, offset=offset))
+                    if chunk:
+                        await resp.write(chunk)
+                    break
+                if not follow:
+                    break
+                await asyncio.sleep(0.5)
+        finally:
+            client.close()
+            await resp.write_eof()
+        return resp
+
+    async def cost_report(request):
+        report = await asyncio.get_event_loop().run_in_executor(
+            None, core.cost_report)
+        return web.json_response(report, dumps=lambda o: json.dumps(
+            o, default=str))
+
+    async def accelerators(request):
+        from skypilot_tpu import catalog
+        name_filter = request.query.get('filter')
+        out = {
+            name: [dataclasses.asdict(o) for o in offs]
+            for name, offs in catalog.list_accelerators(name_filter).items()
+        }
+        return web.json_response(out)
+
+    async def check(request):
+        from skypilot_tpu import clouds as clouds_lib
+        out = {}
+        for name, cloud in clouds_lib.CLOUD_REGISTRY.items():
+            ok, reason = cloud.check_credentials()
+            out[name] = {'enabled': ok, 'reason': reason}
+        return web.json_response(out)
+
+    app.router.add_get('/api/health', health)
+    app.router.add_get('/requests/{request_id}', get_request)
+    app.router.add_get('/requests', list_requests)
+    app.router.add_post('/launch', launch)
+    app.router.add_post('/exec', exec_)
+    app.router.add_get('/status', status)
+    app.router.add_post('/down', down)
+    app.router.add_post('/stop', stop)
+    app.router.add_post('/start', start)
+    app.router.add_post('/autostop', autostop)
+    app.router.add_get('/queue/{cluster_name}', queue)
+    app.router.add_post('/cancel', cancel)
+    app.router.add_get('/logs/{cluster_name}/{job_id}', logs)
+    app.router.add_get('/cost_report', cost_report)
+    app.router.add_get('/accelerators', accelerators)
+    app.router.add_get('/check', check)
+    return app
+
+
+def main() -> None:
+    import argparse
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--port', type=int, default=8700)
+    parser.add_argument('--host', default='127.0.0.1')
+    args = parser.parse_args()
+    web.run_app(make_app(), host=args.host, port=args.port,
+                print=lambda *a: logger.info(
+                    f'API server on {args.host}:{args.port}'))
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,56 @@
+"""Request executor: LONG vs SHORT pools (parity:
+sky/server/requests/executor.py:1-20 design note).
+
+LONG requests (launch/provision/down — minutes, hold cluster locks) and
+SHORT requests (status/queue/cancel — sub-second) get separate thread
+pools so a slow provision never starves `status`.  Results/errors persist
+to the requests DB; the HTTP layer returns request ids immediately.
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import traceback
+from typing import Any, Callable, Dict
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.server import requests_db
+from skypilot_tpu.server.requests_db import RequestStatus
+
+logger = sky_logging.init_logger(__name__)
+
+_LONG_WORKERS = 4
+_SHORT_WORKERS = 16
+
+
+class RequestExecutor:
+    def __init__(self) -> None:
+        self._long = concurrent.futures.ThreadPoolExecutor(
+            _LONG_WORKERS, thread_name_prefix='skytpu-long')
+        self._short = concurrent.futures.ThreadPoolExecutor(
+            _SHORT_WORKERS, thread_name_prefix='skytpu-short')
+
+    def submit(self, name: str, body: Dict[str, Any],
+               fn: Callable[[], Any], long: bool = True) -> str:
+        request_id = requests_db.create(name, body,
+                                        'long' if long else 'short')
+        pool = self._long if long else self._short
+
+        def work():
+            requests_db.set_status(request_id, RequestStatus.RUNNING)
+            try:
+                result = fn()
+                requests_db.set_status(request_id, RequestStatus.SUCCEEDED,
+                                       result=result)
+            except Exception as e:  # pylint: disable=broad-except
+                logger.warning(f'request {name}/{request_id} failed: {e}')
+                requests_db.set_status(
+                    request_id, RequestStatus.FAILED,
+                    error=f'{type(e).__name__}: {e}\n'
+                          f'{traceback.format_exc()}')
+
+        pool.submit(work)
+        return request_id
+
+    def shutdown(self) -> None:
+        self._long.shutdown(wait=False, cancel_futures=True)
+        self._short.shutdown(wait=False, cancel_futures=True)

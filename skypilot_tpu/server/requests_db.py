@@ -1,0 +1,111 @@
+"""Persisted async requests (parity: sky/server/requests/requests.py).
+
+Every API call becomes a request row; clients poll `GET /requests/{id}`
+(the reference's RequestId + stream_and_get pattern).  Persistence makes
+requests resumable after client disconnects — the reference's chaos-proxy
+tests exercise exactly this property.
+"""
+from __future__ import annotations
+
+import enum
+import json
+import os
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.utils import db_utils
+
+
+class RequestStatus(enum.Enum):
+    PENDING = 'PENDING'
+    RUNNING = 'RUNNING'
+    SUCCEEDED = 'SUCCEEDED'
+    FAILED = 'FAILED'
+    CANCELLED = 'CANCELLED'
+
+    def is_terminal(self) -> bool:
+        return self in (RequestStatus.SUCCEEDED, RequestStatus.FAILED,
+                        RequestStatus.CANCELLED)
+
+
+def _db_path() -> str:
+    return os.path.expanduser(
+        os.environ.get('SKYTPU_REQUESTS_DB', '~/.skytpu/requests.db'))
+
+
+_DDL = [
+    """CREATE TABLE IF NOT EXISTS requests (
+        request_id TEXT PRIMARY KEY,
+        name TEXT,
+        status TEXT,
+        created_at REAL,
+        finished_at REAL,
+        body TEXT,
+        result TEXT,
+        error TEXT,
+        schedule_type TEXT
+    )""",
+]
+
+
+def _ensure() -> str:
+    path = _db_path()
+    db_utils.ensure_schema(path, _DDL)
+    return path
+
+
+def create(name: str, body: Dict[str, Any],
+           schedule_type: str = 'long') -> str:
+    request_id = uuid.uuid4().hex[:16]
+    db_utils.execute(
+        _ensure(),
+        'INSERT INTO requests (request_id, name, status, created_at, body, '
+        'schedule_type) VALUES (?,?,?,?,?,?)',
+        (request_id, name, RequestStatus.PENDING.value, time.time(),
+         json.dumps(body), schedule_type))
+    return request_id
+
+
+def set_status(request_id: str, status: RequestStatus,
+               result: Any = None, error: Optional[str] = None) -> None:
+    sets = ['status=?']
+    params: list = [status.value]
+    if status.is_terminal():
+        sets.append('finished_at=?')
+        params.append(time.time())
+    if result is not None:
+        sets.append('result=?')
+        params.append(json.dumps(result, default=str))
+    if error is not None:
+        sets.append('error=?')
+        params.append(error)
+    params.append(request_id)
+    db_utils.execute(_ensure(), f'UPDATE requests SET {", ".join(sets)} '
+                     'WHERE request_id=?', tuple(params))
+
+
+def get(request_id: str) -> Optional[Dict[str, Any]]:
+    row = db_utils.query_one(
+        _ensure(), 'SELECT * FROM requests WHERE request_id=?',
+        (request_id,))
+    if row is None:
+        return None
+    return {
+        'request_id': row['request_id'],
+        'name': row['name'],
+        'status': RequestStatus(row['status']),
+        'created_at': row['created_at'],
+        'finished_at': row['finished_at'],
+        'body': json.loads(row['body'] or '{}'),
+        'result': json.loads(row['result']) if row['result'] else None,
+        'error': row['error'],
+    }
+
+
+def list_requests(limit: int = 100) -> List[Dict[str, Any]]:
+    rows = db_utils.query(
+        _ensure(),
+        'SELECT request_id FROM requests ORDER BY created_at DESC LIMIT ?',
+        (limit,))
+    return [get(r['request_id']) for r in rows]

@@ -1,0 +1,136 @@
+"""API server + SDK + CLI tests with an in-process server
+(model: reference tests/test_api.py + mock_client_requests fixture)."""
+import threading
+import time
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestServer
+
+from skypilot_tpu.agent.job_queue import JobStatus
+
+
+@pytest.fixture
+def api_server(tmp_home, enable_all_clouds, monkeypatch):
+    """Real aiohttp server on a random port, in a background thread."""
+    import asyncio
+    from skypilot_tpu.server.app import make_app
+
+    loop = asyncio.new_event_loop()
+    server_holder = {}
+
+    def run():
+        asyncio.set_event_loop(loop)
+        server = TestServer(make_app())
+        loop.run_until_complete(server.start_server())
+        server_holder['server'] = server
+        server_holder['port'] = server.port
+        loop.run_forever()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    deadline = time.time() + 10
+    while 'port' not in server_holder and time.time() < deadline:
+        time.sleep(0.05)
+    url = f'http://127.0.0.1:{server_holder["port"]}'
+    monkeypatch.setenv('SKYTPU_API_SERVER', url)
+    yield url
+    asyncio.run_coroutine_threadsafe(
+        server_holder['server'].close(), loop).result(timeout=10)
+    loop.call_soon_threadsafe(loop.stop)
+
+
+def _mk_local_task(run='echo api-hello'):
+    from skypilot_tpu.resources import Resources
+    from skypilot_tpu.task import Task
+    t = Task('apitask', run=run)
+    t.set_resources(Resources.from_yaml_config({'infra': 'local'}))
+    return t
+
+
+def test_health_and_check(api_server):
+    from skypilot_tpu.client import sdk
+    assert sdk.api_info()['status'] == 'healthy'
+    checks = sdk.check()
+    assert checks['local']['enabled']
+
+
+def test_launch_via_sdk_end_to_end(api_server):
+    from skypilot_tpu.client import sdk
+    request_id = sdk.launch(_mk_local_task(), 'apie2e')
+    result = sdk.get(request_id)
+    assert result['cluster_name'] == 'apie2e'
+    job_id = result['job_id']
+    # poll queue until terminal
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        jobs = sdk.queue('apie2e')
+        rec = next(j for j in jobs if j['job_id'] == job_id)
+        if JobStatus(rec['status']).is_terminal():
+            break
+        time.sleep(0.3)
+    assert rec['status'] == 'SUCCEEDED'
+    # status via REST
+    records = sdk.status()
+    assert records[0]['name'] == 'apie2e'
+    assert records[0]['status'] == 'UP'
+    # logs via streaming endpoint
+    import io
+    buf = io.StringIO()
+    sdk.tail_logs('apie2e', job_id, follow=False, out=buf)
+    assert 'api-hello' in buf.getvalue()
+    # cost report + down
+    assert sdk.cost_report()[0]['name'] == 'apie2e'
+    sdk.get(sdk.down('apie2e'))
+    assert sdk.status() == []
+
+
+def test_failed_request_surfaces_error(api_server):
+    from skypilot_tpu import exceptions
+    from skypilot_tpu.client import sdk
+    t = _mk_local_task()
+    with pytest.raises(exceptions.ApiServerError) as err:
+        sdk.get(sdk.exec_(t, 'missing-cluster'))
+    assert 'does not exist' in str(err.value)
+
+
+def test_accelerators_endpoint(api_server):
+    from skypilot_tpu.client import sdk
+    accs = sdk.accelerators('v5p')
+    assert accs and all('v5p' in k for k in accs)
+
+
+def test_requests_persisted(api_server, tmp_home):
+    from skypilot_tpu.client import sdk
+    from skypilot_tpu.server import requests_db
+    request_id = sdk.launch(_mk_local_task(), 'persist1')
+    sdk.get(request_id)
+    rec = requests_db.get(request_id)
+    assert rec is not None
+    assert rec['status'].value == 'SUCCEEDED'
+    sdk.get(sdk.down('persist1'))
+
+
+def test_cli_entrypoints(api_server, tmp_path):
+    from click.testing import CliRunner
+    from skypilot_tpu.client.cli import cli
+    runner = CliRunner()
+    # accelerators listing straight through REST
+    result = runner.invoke(cli, ['accelerators', 'v6e'])
+    assert result.exit_code == 0, result.output
+    assert 'tpu-v6e-8' in result.output
+    # check
+    result = runner.invoke(cli, ['check'])
+    assert result.exit_code == 0
+    assert 'local: enabled' in result.output
+    # launch a YAML task end-to-end
+    yaml_path = tmp_path / 'task.yaml'
+    yaml_path.write_text(
+        'name: cliyaml\nresources:\n  infra: local\nrun: echo from-cli\n')
+    result = runner.invoke(cli, ['launch', str(yaml_path), '-c', 'clic'])
+    assert result.exit_code == 0, result.output
+    assert 'from-cli' in result.output
+    result = runner.invoke(cli, ['status'])
+    assert 'clic' in result.output
+    result = runner.invoke(cli, ['down', 'clic', '--yes'])
+    assert result.exit_code == 0, result.output
