@@ -22,6 +22,7 @@ handles recreate-and-resume).
 from __future__ import annotations
 
 import os
+import shlex
 import subprocess
 from typing import Any, Dict, List, Optional
 
@@ -97,7 +98,7 @@ class GangJob:
             full_cmd = cmd
             if workdir and not isinstance(runner,
                                           runner_lib.LocalProcessRunner):
-                full_cmd = f'cd {workdir} && {cmd}'
+                full_cmd = f'cd {shlex.quote(workdir)} && {cmd}'
             procs.append(runner.popen(full_cmd, env=env,
                                       log_path=log_path))
         self._procs = procs

@@ -74,15 +74,6 @@ def submit(name: Optional[str], spec: Dict[str, Any]) -> int:
 def set_status(job_id: int, status: JobStatus,
                returncode: Optional[int] = None) -> None:
     path = _ensure()
-    # CANCELLED is sticky: a cancel that lands between the scheduler's
-    # dequeue and its first status write must not be overwritten by the
-    # gang's later SETTING_UP/RUNNING/SUCCEEDED transitions.
-    cur = db_utils.query_one(path,
-                             'SELECT status FROM jobs WHERE job_id=?',
-                             (job_id,))
-    if cur is not None and cur['status'] == JobStatus.CANCELLED.value and \
-            status is not JobStatus.CANCELLED:
-        return
     now = time.time()
     sets, params = ['status=?'], [status.value]
     if status is JobStatus.RUNNING or status is JobStatus.SETTING_UP:
@@ -95,8 +86,17 @@ def set_status(job_id: int, status: JobStatus,
         sets.append('returncode=?')
         params.append(returncode)
     params.append(job_id)
-    db_utils.execute(path, f'UPDATE jobs SET {", ".join(sets)} '
-                     'WHERE job_id=?', tuple(params))
+    # CANCELLED is sticky: a cancel that lands between the scheduler's
+    # dequeue and its first status write must not be overwritten by the
+    # gang's later SETTING_UP/RUNNING/SUCCEEDED transitions.  The guard is
+    # part of the UPDATE itself (single statement, atomic) so no window
+    # exists between checking and writing.
+    where = 'WHERE job_id=?'
+    if status is not JobStatus.CANCELLED:
+        where += ' AND status != ?'
+        params.append(JobStatus.CANCELLED.value)
+    db_utils.execute(path, f'UPDATE jobs SET {", ".join(sets)} {where}',
+                     tuple(params))
 
 
 def get(job_id: int) -> Optional[Dict[str, Any]]:

@@ -11,6 +11,7 @@ mutations happen under the cluster lock, mirroring the reference's
 from __future__ import annotations
 
 import os
+import shlex
 import socket
 import subprocess
 import sys
@@ -262,7 +263,7 @@ class TpuVmBackend(backend_lib.Backend):
                 dst = os.path.join(self._agent_home(handle),
                                    dst.lstrip('/~'))
             for runner in self._host_runners(handle):
-                runner.run(f'mkdir -p $(dirname {dst})')
+                runner.run(f'mkdir -p "$(dirname {shlex.quote(dst)})"')
                 runner.rsync(src_path, dst, up=True)
 
     def setup(self, handle: ClusterHandle, task: task_lib.Task) -> None:

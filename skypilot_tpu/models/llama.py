@@ -127,6 +127,23 @@ def _constrain_activations(x: jax.Array, mesh: Optional[Mesh],
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
 
+class OneHotEmbed(nn.Embed):
+    """Embedding lookup as a one-hot matmul.
+
+    A gather from a vocab-sharded table ('vocab' -> tensor axis) forces XLA
+    to replicate-then-repartition the table ("involuntary full
+    rematerialization").  A one-hot matmul instead contracts over the
+    sharded vocab axis on the MXU and lowers to a clean psum.  Used when a
+    mesh with tensor parallelism is present; plain gather otherwise (the
+    matmul costs B*S*V*D FLOPs, wasteful single-chip).
+    """
+
+    def __call__(self, inputs: jax.Array) -> jax.Array:
+        onehot = jax.nn.one_hot(inputs, self.num_embeddings,
+                                dtype=self.dtype)
+        return jnp.dot(onehot, self.embedding.astype(self.dtype))
+
+
 class RMSNorm(nn.Module):
     eps: float
     dtype: Any
@@ -202,25 +219,38 @@ class Attention(nn.Module):
                            cfg.dtype)
         idx = self.variable('cache', 'index',
                             lambda: jnp.zeros((), jnp.int32))
-        if not is_init:
-            cur = idx.value
+        # Write incoming k/v and advance the index on BOTH the init and
+        # steady-state paths: the standard prefill pattern is a first
+        # apply(decode=True) over the full prompt, which must land the
+        # prompt's K/V in the cache (a silently-empty cache would make all
+        # later decode steps attend to zeros).
+        if is_init:
+            # Fast path: the cache was just created, so cur is statically
+            # 0 and the prompt occupies cache[:S].  Attend causal over the
+            # prompt itself — O(S^2), not O(S * max_len).
             ck.value = jax.lax.dynamic_update_slice(
-                ck.value, k, (0, 0, cur, 0))
+                ck.value, k, (0, 0, 0, 0))
             cv.value = jax.lax.dynamic_update_slice(
-                cv.value, v, (0, 0, cur, 0))
-            idx.value = cur + q.shape[2]
-            k_all, v_all = ck.value, cv.value
-            q_pos = cur + jnp.arange(q.shape[2])[None, :]
-            k_pos = jnp.arange(max_len)[None, :]
-            # mask future cache slots via positions
-            out = attn_lib.mha_reference(
-                q, k_all, v_all, causal=True,
-                segment_positions=jnp.broadcast_to(q_pos, (q.shape[0],) +
-                                                   q_pos.shape[1:]),
-                kv_positions=jnp.broadcast_to(k_pos,
-                                              (q.shape[0], max_len)))
-            return k_all, v_all, out
-        return k, v, attn_lib.mha_reference(q, k, v, causal=True)
+                cv.value, v, (0, 0, 0, 0))
+            idx.value = jnp.asarray(q.shape[2], jnp.int32)
+            return k, v, attn_lib.mha_reference(q, k, v, causal=True)
+        cur = idx.value
+        ck.value = jax.lax.dynamic_update_slice(
+            ck.value, k, (0, 0, cur, 0))
+        cv.value = jax.lax.dynamic_update_slice(
+            cv.value, v, (0, 0, cur, 0))
+        idx.value = cur + q.shape[2]
+        k_all, v_all = ck.value, cv.value
+        q_pos = cur + jnp.arange(q.shape[2])[None, :]
+        k_pos = jnp.arange(max_len)[None, :]
+        # mask future cache slots via positions
+        out = attn_lib.mha_reference(
+            q, k_all, v_all, causal=True,
+            segment_positions=jnp.broadcast_to(q_pos, (q.shape[0],) +
+                                               q_pos.shape[1:]),
+            kv_positions=jnp.broadcast_to(k_pos,
+                                          (q.shape[0], max_len)))
+        return k_all, v_all, out
 
 
 class MLP(nn.Module):
@@ -271,7 +301,10 @@ class Llama(nn.Module):
         if positions is None:
             positions = jnp.broadcast_to(
                 jnp.arange(tokens.shape[1])[None, :], tokens.shape)
-        embed = nn.Embed(
+        tensor_parallel = (self.mesh is not None
+                           and self.mesh.shape.get('tensor', 1) > 1)
+        embed_cls = OneHotEmbed if tensor_parallel else nn.Embed
+        embed = embed_cls(
             cfg.vocab_size, cfg.dim, dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
             embedding_init=nn.with_logical_partitioning(

@@ -60,12 +60,15 @@ def test_llama_decode_cache_matches_full_forward():
     tokens = jax.random.randint(jax.random.PRNGKey(1), (1, seq), 0,
                                 CFG.vocab_size)
     full = model.apply(variables, tokens)
-    # Prime the cache then decode token-by-token.
-    cache_vars = model.apply(variables, tokens[:, :1], decode=True,
-                             mutable=['cache'])[1]
-    logits = None
+    # Prefill the first half of the prompt in one decode=True apply (its
+    # K/V must land in the cache), then decode the rest token-by-token.
+    prefill = seq // 2
+    logits, cache_vars = model.apply(variables, tokens[:, :prefill],
+                                     decode=True, mutable=['cache'])
+    np.testing.assert_allclose(logits[0, -1], full[0, prefill - 1],
+                               rtol=1e-4, atol=1e-4)
     state = {**variables, **cache_vars}
-    for i in range(seq):
+    for i in range(prefill, seq):
         positions = jnp.array([[i]])
         logits, cache_vars = model.apply(
             state, tokens[:, i:i + 1], positions=positions, decode=True,
