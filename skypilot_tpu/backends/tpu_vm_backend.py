@@ -50,7 +50,9 @@ class TpuVmBackend(backend_lib.Backend):
     # ----- provision ---------------------------------------------------------
     def provision(self, task: task_lib.Task, cluster_name: str,
                   dryrun: bool = False,
-                  retry_until_up: bool = False) -> Optional[ClusterHandle]:
+                  retry_until_up: bool = False,
+                  blocked_resources: Optional[list] = None
+                  ) -> Optional[ClusterHandle]:
         if dryrun:
             return None
         with locks.cluster_lock(cluster_name):
@@ -69,7 +71,8 @@ class TpuVmBackend(backend_lib.Backend):
                 # existing nodes are reused instead of orphaned by a fresh
                 # failover provision landing elsewhere.
                 return self._restart_locked(handle)
-            return self._provision_locked(task, cluster_name)
+            return self._provision_locked(task, cluster_name,
+                                          blocked_resources)
 
     def _check_reusable(self, handle: ClusterHandle,
                         task: task_lib.Task) -> bool:
@@ -102,7 +105,9 @@ class TpuVmBackend(backend_lib.Backend):
         return handle
 
     def _provision_locked(self, task: task_lib.Task,
-                          cluster_name: str) -> ClusterHandle:
+                          cluster_name: str,
+                          blocked_resources: Optional[list] = None
+                          ) -> ClusterHandle:
         def provision_fn(candidate: resources_lib.Resources):
             authorized_key = None
             if candidate.cloud != 'local':
@@ -134,9 +139,9 @@ class TpuVmBackend(backend_lib.Backend):
 
         global_user_state.add_cluster_event(cluster_name, 'provision_start',
                                             '')
-        result = failover.provision_with_retries(task, cluster_name,
-                                                 provision_fn,
-                                                 cleanup_fn=cleanup_fn)
+        result = failover.provision_with_retries(
+            task, cluster_name, provision_fn, cleanup_fn=cleanup_fn,
+            blocked_resources=blocked_resources)
         candidate = result.resources
         info = provision_lib.get_cluster_info(candidate.cloud, cluster_name,
                                               region=result.record.region,

@@ -224,6 +224,63 @@ def check():
 
 
 @cli.group()
+def jobs():
+    """Managed jobs: auto-recovering tasks on preemptible TPU slices."""
+
+
+@jobs.command('launch')
+@click.argument('entrypoint', nargs=-1)
+@_apply(_task_options)
+def jobs_launch(entrypoint, cluster, detach_run, **overrides):
+    """Launch a managed job (auto-recovers from preemption)."""
+    del cluster  # managed jobs own their ephemeral clusters
+    task = _load_task(entrypoint, **overrides)
+    result = sdk.get(sdk.jobs_launch(task, overrides.get('name')))
+    click.echo(f'Managed job {result["job_id"]} submitted.')
+    if not detach_run:
+        import time as _time
+        # Logs become available once the controller starts the job.
+        for _ in range(600):
+            recs = [r for r in sdk.jobs_queue()
+                    if r['job_id'] == result['job_id']]
+            if recs and recs[0].get('cluster_job_id') is not None:
+                break
+            _time.sleep(1)
+        sdk.jobs_tail_logs(result['job_id'])
+
+
+@jobs.command('queue')
+def jobs_queue_cmd():
+    """List managed jobs."""
+    rows = []
+    for r in sdk.jobs_queue():
+        rows.append([
+            r['job_id'], r.get('name') or '-', r['status'],
+            r.get('cluster_name') or '-',
+            r.get('recovery_count', 0),
+            (r.get('failure_reason') or '')[:40],
+        ])
+    ux_utils.print_table(
+        ['ID', 'NAME', 'STATUS', 'CLUSTER', 'RECOVERIES', 'REASON'], rows)
+
+
+@jobs.command('cancel')
+@click.argument('job_id', type=int)
+def jobs_cancel_cmd(job_id):
+    """Cancel a managed job (tears its cluster down)."""
+    ok = sdk.jobs_cancel(job_id)
+    click.echo('Cancel requested.' if ok else 'Job already finished.')
+
+
+@jobs.command('logs')
+@click.argument('job_id', type=int)
+@click.option('--no-follow', is_flag=True, default=False)
+def jobs_logs_cmd(job_id, no_follow):
+    """Tail a managed job's logs."""
+    sdk.jobs_tail_logs(job_id, follow=not no_follow)
+
+
+@cli.group()
 def api():
     """API server management."""
 

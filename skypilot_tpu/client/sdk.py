@@ -162,6 +162,35 @@ def tail_logs(cluster_name: str, job_id: int, follow: bool = True,
         out.flush()
 
 
+# ----- managed jobs ----------------------------------------------------------
+def jobs_launch(task: task_lib.Task, name: Optional[str] = None) -> str:
+    return _post('/jobs/launch', {'task': task.to_yaml_config(),
+                                  'name': name})['request_id']
+
+
+def jobs_queue() -> List[Dict[str, Any]]:
+    return _get('/jobs/queue')
+
+
+def jobs_cancel(job_id: int) -> bool:
+    return _post('/jobs/cancel', {'job_id': job_id})['cancelled']
+
+
+def jobs_tail_logs(job_id: int, follow: bool = True, out=None) -> None:
+    ensure_server_running()
+    out = out or sys.stdout
+    resp = requests_lib.get(
+        f'{server_url()}/jobs/logs/{job_id}',
+        params={'follow': '1' if follow else '0'}, stream=True,
+        timeout=None)
+    if resp.status_code >= 400:
+        raise exceptions.ApiServerError(
+            f'jobs logs failed ({resp.status_code}): {resp.text}')
+    for chunk in resp.iter_content(chunk_size=None):
+        out.write(chunk.decode(errors='replace'))
+        out.flush()
+
+
 def cost_report() -> List[Dict[str, Any]]:
     return _get('/cost_report')
 

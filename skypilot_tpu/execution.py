@@ -42,10 +42,13 @@ def launch(
     detach_run: bool = False,
     stages: Optional[List[Stage]] = None,
     quiet_optimizer: bool = False,
+    blocked_resources: Optional[list] = None,
 ) -> Tuple[Optional[int], Optional[ClusterHandle]]:
     """Provision (or reuse) a cluster and run the task on it.
 
     Returns (job_id, handle).  (reference: sky/execution.py:539)
+    blocked_resources: placements the failover engine must skip (used by
+    managed-job recovery to avoid a zone that just preempted the task).
     """
     cluster_name = cluster_name or f'sky-{common_utils.generate_id()}'
     common_utils.validate_cluster_name(cluster_name)
@@ -63,7 +66,8 @@ def launch(
 
     handle: Optional[ClusterHandle] = None
     if Stage.PROVISION in stages:
-        handle = backend.provision(task, cluster_name)
+        handle = backend.provision(task, cluster_name,
+                                   blocked_resources=blocked_resources)
     else:
         record = global_user_state.get_cluster(cluster_name)
         if record is None:

@@ -1,0 +1,287 @@
+"""Managed-jobs controller (parity: sky/jobs/controller.py:98 JobController,
+:962 ControllerManager; scheduler caps sky/jobs/scheduler.py:194).
+
+One controller thread per managed job, running "consolidated" inside the
+process that owns the jobs DB (the API server, or the caller for
+library-direct use) — the reference's consolidation mode
+(sky/jobs/server/core.py:314).  A dedicated controller VM is unnecessary
+for TPU fleets: the controller does no compute, only polling and REST
+calls, and threads survive as long as the API server, whose requests DB
+already makes restarts resumable (maybe_start_controllers re-adopts
+non-terminal jobs on startup).
+
+Controller loop per job:
+  launch (failover engine walks zones) -> poll cluster job status ->
+  - SUCCEEDED            -> teardown cluster, job SUCCEEDED
+  - user-code failure    -> cluster still healthy? restart up to
+                            max_restarts_on_errors, else FAILED
+  - agent unreachable /
+    cluster preempted    -> RECOVERING: delete stale slice, re-provision
+                            (possibly new zone), resubmit, RUNNING
+Preemption is detected exactly like the reference: reconcile the state DB
+against cloud truth (backend_utils.refresh_cluster_status ->
+provision.query_instances), sky/backends/backend_utils.py:2222.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import sky_logging
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.agent.job_queue import JobStatus as ClusterJobStatus
+from skypilot_tpu.backends import TpuVmBackend
+from skypilot_tpu.backends import backend_utils
+from skypilot_tpu.global_user_state import ClusterStatus
+from skypilot_tpu.jobs import state
+from skypilot_tpu.jobs.recovery_strategy import StrategyExecutor
+from skypilot_tpu.jobs.state import ManagedJobStatus
+
+logger = sky_logging.init_logger(__name__)
+
+
+def _poll_interval() -> float:
+    return float(os.environ.get('SKYTPU_JOBS_POLL_INTERVAL', '10'))
+
+
+def cluster_name_for_job(job_id: int, name: Optional[str]) -> str:
+    base = (name or 'task').lower().replace('_', '-')[:20].strip('-')
+    return f'jobs-{job_id}-{base}'
+
+
+class JobController:
+    """Drives one managed job to a terminal state."""
+
+    def __init__(self, job_id: int) -> None:
+        self.job_id = job_id
+        self.backend = TpuVmBackend()
+
+    # ----- polling helpers ---------------------------------------------------
+    def _cluster_job_status(self, cluster_name: str,
+                            cluster_job_id: int
+                            ) -> Optional[ClusterJobStatus]:
+        """Status of the job on its cluster, or None when the cluster/agent
+        cannot answer (candidate preemption)."""
+        record = global_user_state.get_cluster(cluster_name)
+        if record is None:
+            return None
+        client = self.backend._agent_client(record['handle'])  # pylint: disable=protected-access
+        try:
+            job = client.get_job(cluster_job_id)
+        except Exception:  # pylint: disable=broad-except
+            return None
+        finally:
+            client.close()
+        if job is None:
+            return None
+        return ClusterJobStatus(job['status'])
+
+    def _cancel_requested(self) -> bool:
+        rec = state.get(self.job_id)
+        return rec is not None and \
+            rec['status'] is ManagedJobStatus.CANCELLING
+
+    def _snapshot_logs(self, cluster_name: str,
+                       cluster_job_id: Optional[int]) -> None:
+        """Persist the run log before the task cluster is torn down, so
+        `jobs logs` works after the job finishes (reference downloads
+        controller-side, sky/jobs/controller.py:201)."""
+        if cluster_job_id is None:
+            return
+        record = global_user_state.get_cluster(cluster_name)
+        if record is None:
+            return
+        client = self.backend._agent_client(record['handle'])  # pylint: disable=protected-access
+        try:
+            data = client.read_logs(cluster_job_id)
+        except Exception:  # pylint: disable=broad-except
+            return
+        finally:
+            client.close()
+        path = state.log_path(self.job_id)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, 'ab') as f:
+            f.write(data)
+
+    # ----- terminal paths ----------------------------------------------------
+    def _finish_cancel(self, strategy: StrategyExecutor,
+                       cluster_job_id: Optional[int]) -> None:
+        record = global_user_state.get_cluster(strategy.cluster_name)
+        if record is not None and cluster_job_id is not None:
+            try:
+                self.backend.cancel_job(record['handle'], cluster_job_id)
+            except Exception:  # pylint: disable=broad-except
+                pass
+        self._snapshot_logs(strategy.cluster_name, cluster_job_id)
+        strategy.cleanup()
+        state.set_status(self.job_id, ManagedJobStatus.CANCELLED)
+        logger.info(f'Managed job {self.job_id} cancelled.')
+
+    # ----- main loop ---------------------------------------------------------
+    def run(self) -> None:
+        rec = state.get(self.job_id)
+        if rec is None or rec['status'].is_terminal():
+            return
+        task = task_lib.Task.from_yaml_config(rec['task_config'])
+        cluster_name = rec['cluster_name'] or cluster_name_for_job(
+            self.job_id, rec['name'] or task.name)
+        strategy = StrategyExecutor.make(task, cluster_name,
+                                         rec['recovery_strategy'])
+        try:
+            self._run_inner(rec, strategy)
+        except exceptions.ResourcesUnavailableError as e:
+            logger.warning(f'Managed job {self.job_id}: placements '
+                           f'exhausted: {e}')
+            state.set_status(self.job_id,
+                             ManagedJobStatus.FAILED_NO_RESOURCE, str(e))
+            strategy.cleanup()
+        except Exception as e:  # pylint: disable=broad-except
+            logger.exception(f'Managed job {self.job_id}: controller '
+                             f'crashed')
+            state.set_status(self.job_id,
+                             ManagedJobStatus.FAILED_CONTROLLER, repr(e))
+            strategy.cleanup()
+        finally:
+            maybe_start_controllers()
+
+    def _run_inner(self, rec: dict, strategy: StrategyExecutor) -> None:
+        job_id = self.job_id
+        cluster_name = strategy.cluster_name
+        max_restarts = int(rec['max_restarts_on_errors'] or 0)
+        cluster_job_id = rec['cluster_job_id']
+
+        if self._cancel_requested():
+            self._finish_cancel(strategy, cluster_job_id)
+            return
+        if cluster_job_id is None:
+            state.set_status(job_id, ManagedJobStatus.STARTING)
+            state.set_cluster(job_id, cluster_name, None)
+            cluster_job_id = strategy.launch()
+            state.set_cluster(job_id, cluster_name, cluster_job_id)
+        state.set_status(job_id, ManagedJobStatus.RUNNING)
+
+        while True:
+            if self._cancel_requested():
+                self._finish_cancel(strategy, cluster_job_id)
+                return
+            status = self._cluster_job_status(cluster_name, cluster_job_id)
+            if status is ClusterJobStatus.SUCCEEDED:
+                state.set_status(job_id, ManagedJobStatus.SUCCEEDED)
+                self._snapshot_logs(cluster_name, cluster_job_id)
+                strategy.cleanup()
+                logger.info(f'Managed job {job_id} SUCCEEDED.')
+                return
+            if status is ClusterJobStatus.CANCELLED:
+                # Cancelled out-of-band on the cluster itself.
+                state.set_status(job_id, ManagedJobStatus.CANCELLED,
+                                 'cluster job cancelled externally')
+                self._snapshot_logs(cluster_name, cluster_job_id)
+                strategy.cleanup()
+                return
+            # Non-success: reconcile against cloud truth BEFORE judging.
+            # A gang failure can be the *symptom* of preemption (a dead
+            # host kills every rank), and a slice can be preempted while
+            # the job still looks RUNNING (partial preemption wedges ICI
+            # collectives; the head agent stays responsive).  Reference:
+            # recovery_strategy.should_restart_on_failure semantics +
+            # backend_utils._update_cluster_status:2222.
+            cl_status = backend_utils.refresh_cluster_status(cluster_name)
+            if cl_status is not ClusterStatus.UP:
+                n = state.bump_recovery_count(job_id)
+                logger.warning(
+                    f'Managed job {job_id}: cluster {cluster_name!r} '
+                    f'lost (status={cl_status}); recovery #{n}.')
+                state.set_status(job_id, ManagedJobStatus.RECOVERING)
+                if self._cancel_requested():
+                    self._finish_cancel(strategy, None)
+                    return
+                cluster_job_id = strategy.recover()
+                state.set_cluster(job_id, cluster_name, cluster_job_id)
+                state.set_status(job_id, ManagedJobStatus.RUNNING)
+                continue
+            if status in (ClusterJobStatus.FAILED,
+                          ClusterJobStatus.FAILED_SETUP):
+                # Genuine user-code failure on a healthy cluster: counts
+                # against max_restarts_on_errors.
+                n = state.bump_restarts_on_errors(job_id)
+                if n > max_restarts:
+                    final = (ManagedJobStatus.FAILED_SETUP if status is
+                             ClusterJobStatus.FAILED_SETUP else
+                             ManagedJobStatus.FAILED)
+                    state.set_status(
+                        job_id, final,
+                        f'cluster job {cluster_job_id} '
+                        f'{status.value} (restarted {n - 1}x)')
+                    self._snapshot_logs(cluster_name, cluster_job_id)
+                    strategy.cleanup()
+                    return
+                logger.info(
+                    f'Managed job {job_id}: user-code failure, '
+                    f'restart {n}/{max_restarts}.')
+                state.set_status(job_id, ManagedJobStatus.RECOVERING)
+                cluster_job_id = strategy.launch()  # cluster is UP;
+                # launch reuses it and just resubmits the job.
+                state.set_cluster(job_id, cluster_name, cluster_job_id)
+                state.set_status(job_id, ManagedJobStatus.RUNNING)
+                continue
+            # RUNNING / PENDING / SETTING_UP on a healthy cluster (or a
+            # transient agent hiccup): poll again.
+            time.sleep(_poll_interval())
+
+
+# ----- controller manager (scheduler) ----------------------------------------
+
+_manager_lock = threading.Lock()
+_controllers: Dict[int, threading.Thread] = {}
+
+
+def _max_parallel() -> int:
+    return int(os.environ.get('SKYTPU_JOBS_MAX_PARALLEL', '16'))
+
+
+def maybe_start_controllers() -> None:
+    """Start controller threads for non-terminal jobs, newest-submitted
+    last, up to the parallelism cap (parity:
+    sky/jobs/scheduler.py:194 maybe_start_controllers)."""
+    with _manager_lock:
+        alive = {jid for jid, th in _controllers.items() if th.is_alive()}
+        capacity = _max_parallel() - len(alive)
+        if capacity <= 0:
+            return
+        for rec in state.nonterminal_jobs():
+            if capacity <= 0:
+                break
+            jid = rec['job_id']
+            if jid in alive:
+                continue
+            th = threading.Thread(
+                target=JobController(jid).run,
+                name=f'jobs-controller-{jid}', daemon=True)
+            _controllers[jid] = th
+            th.start()
+            capacity -= 1
+
+
+def controller_alive(job_id: int) -> bool:
+    with _manager_lock:
+        th = _controllers.get(job_id)
+        return th is not None and th.is_alive()
+
+
+def wait_job(job_id: int, timeout_s: float = 600.0) -> ManagedJobStatus:
+    """Block until the job reaches a terminal state (SDK/test helper)."""
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        rec = state.get(job_id)
+        if rec is None:
+            raise exceptions.JobNotFoundError(f'managed job {job_id}')
+        if rec['status'].is_terminal():
+            return rec['status']
+        time.sleep(0.2)
+    raise exceptions.ManagedJobStatusError(
+        f'managed job {job_id} not terminal after {timeout_s}s '
+        f'(status={state.get(job_id)["status"]})')  # type: ignore[index]

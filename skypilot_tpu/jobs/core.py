@@ -1,0 +1,84 @@
+"""Managed-jobs user API (parity: sky/jobs/server/core.py launch :244,
+queue, cancel; logs via the task cluster's agent).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.jobs import controller as controller_lib
+from skypilot_tpu.jobs import state
+from skypilot_tpu.jobs.recovery_strategy import StrategyName
+
+
+def _recovery_config(task: task_lib.Task) -> Dict[str, Any]:
+    """Parse `job_recovery` off the task's resources: either a strategy
+    name string or {strategy, max_restarts_on_errors}."""
+    raw = task.any_resources.job_recovery
+    if raw is None:
+        return {'strategy': StrategyName.FAILOVER.value,
+                'max_restarts_on_errors': 0}
+    if isinstance(raw, str):
+        return {'strategy': raw.upper(), 'max_restarts_on_errors': 0}
+    if isinstance(raw, dict):
+        return {
+            'strategy': str(raw.get('strategy', 'FAILOVER')).upper(),
+            'max_restarts_on_errors': int(
+                raw.get('max_restarts_on_errors', 0)),
+        }
+    raise exceptions.InvalidResourcesError(
+        f'job_recovery must be a string or object, got {raw!r}')
+
+
+def launch(task: task_lib.Task, name: Optional[str] = None) -> int:
+    """Submit a managed (auto-recovering) job; returns the managed job id.
+
+    The controller provisions an ephemeral task cluster, monitors it, and
+    on preemption deletes the stale slice, re-provisions (failing over
+    zones as needed) and re-runs the task, which resumes from its latest
+    checkpoint.
+    """
+    rec = _recovery_config(task)
+    StrategyName(rec['strategy'])  # validate early, before persisting
+    job_id = state.submit(name or task.name, task.to_yaml_config(),
+                          recovery_strategy=rec['strategy'],
+                          max_restarts_on_errors=rec[
+                              'max_restarts_on_errors'])
+    controller_lib.maybe_start_controllers()
+    return job_id
+
+
+def queue(refresh: bool = False) -> List[Dict[str, Any]]:
+    del refresh  # controller threads keep state fresh
+    return state.list_jobs()
+
+
+def cancel(job_id: int) -> bool:
+    """Request cancellation; the controller cancels the cluster job and
+    tears the cluster down."""
+    ok = state.request_cancel(job_id)
+    if ok:
+        # Adopt orphaned jobs (e.g. after an API-server restart) so the
+        # cancel is actually processed.
+        controller_lib.maybe_start_controllers()
+    return ok
+
+
+def tail_logs(job_id: int, follow: bool = True) -> int:
+    rec = state.get(job_id)
+    if rec is None:
+        raise exceptions.JobNotFoundError(f'managed job {job_id}')
+    if rec['cluster_name'] is None or rec['cluster_job_id'] is None:
+        raise exceptions.ClusterNotUpError(
+            f'managed job {job_id} has not started yet '
+            f'(status={rec["status"].value})')
+    record = global_user_state.get_cluster(rec['cluster_name'])
+    if record is None:
+        raise exceptions.ClusterDoesNotExistError(
+            f'cluster for managed job {job_id} is not up '
+            f'(status={rec["status"].value})')
+    from skypilot_tpu.backends import TpuVmBackend
+    return TpuVmBackend().tail_logs(record['handle'],
+                                    rec['cluster_job_id'], follow=follow)

@@ -1,0 +1,109 @@
+"""Recovery strategies for managed jobs (parity:
+sky/jobs/recovery_strategy.py:656 FailoverStrategyExecutor, :757
+EagerFailoverStrategyExecutor), tuned for TPU preemption semantics.
+
+A spot TPU pod slice is preempted whole and cannot be restarted in place
+(sky/clouds/gcp.py:219-226, :1095-1101: stale nodes need manual delete) —
+so recovery is always: delete the stale slice, re-provision (the failover
+engine walks zones), re-run the task.  Checkpoint/resume is the workload's
+job (trainer.restore_if_available reloads the newest step from the
+checkpoint dir; the managed-jobs convention is to put that dir on shared
+storage).
+
+FAILOVER        retry the original placement first (the slice may come
+                right back in the same zone), then let the failover engine
+                walk other zones.
+EAGER_FAILOVER  blocklist the preempted zone immediately — a zone that
+                just preempted us has demonstrably tight capacity.
+"""
+from __future__ import annotations
+
+import enum
+from typing import List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import execution
+from skypilot_tpu import global_user_state
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu import sky_logging
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.backends import TpuVmBackend
+
+logger = sky_logging.init_logger(__name__)
+
+
+class StrategyName(enum.Enum):
+    FAILOVER = 'FAILOVER'
+    EAGER_FAILOVER = 'EAGER_FAILOVER'
+
+
+class StrategyExecutor:
+    """Launch/recover one managed job's task cluster."""
+
+    def __init__(self, task: task_lib.Task, cluster_name: str,
+                 strategy: StrategyName = StrategyName.FAILOVER) -> None:
+        self.task = task
+        self.cluster_name = cluster_name
+        self.strategy = strategy
+        # Zones that preempted us (EAGER_FAILOVER blocklist, accumulated
+        # across recoveries like the reference's _blocked_resources).
+        self._blocked: List[resources_lib.Resources] = []
+
+    @classmethod
+    def make(cls, task: task_lib.Task, cluster_name: str,
+             strategy: Optional[str]) -> 'StrategyExecutor':
+        name = StrategyName((strategy or 'FAILOVER').upper())
+        return cls(task, cluster_name, name)
+
+    def launch(self) -> int:
+        """Provision (with failover) + run; returns the cluster job id."""
+        job_id, _ = execution.launch(
+            self.task, self.cluster_name, detach_run=True,
+            quiet_optimizer=True, blocked_resources=self._blocked or None)
+        assert job_id is not None
+        return job_id
+
+    def recover(self) -> int:
+        """Delete the stale slice and relaunch; returns new cluster job id.
+
+        Raises ResourcesUnavailableError when every placement is exhausted
+        (the controller maps that to FAILED_NO_RESOURCE).
+        """
+        record = global_user_state.get_cluster(self.cluster_name)
+        if record is not None:
+            if self.strategy is StrategyName.EAGER_FAILOVER:
+                handle = record['handle']
+                if handle.region is not None:
+                    infra = f'{handle.cloud}/{handle.region}'
+                    if handle.zone:
+                        infra += f'/{handle.zone}'
+                    entry = resources_lib.Resources.from_yaml_config(
+                        {'infra': infra})
+                    self._blocked.append(entry)
+                    logger.info(
+                        f'EAGER_FAILOVER: blocklisting {infra} for '
+                        f'{self.cluster_name!r}')
+            try:
+                TpuVmBackend().teardown(record['handle'], terminate=True)
+            except Exception as e:  # pylint: disable=broad-except
+                # The slice may already be deleted by the cloud; recovery
+                # proceeds, but log it — a half-dead slice left behind
+                # would keep billing.
+                logger.warning(
+                    f'teardown of stale cluster {self.cluster_name!r} '
+                    f'failed (continuing recovery): {e}')
+                if global_user_state.get_cluster(
+                        self.cluster_name) is not None:
+                    global_user_state.remove_cluster(self.cluster_name)
+        return self.launch()
+
+    def cleanup(self) -> None:
+        """Tear down the task cluster (job finished or cancelled)."""
+        record = global_user_state.get_cluster(self.cluster_name)
+        if record is None:
+            return
+        try:
+            TpuVmBackend().teardown(record['handle'], terminate=True)
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning(
+                f'cleanup of cluster {self.cluster_name!r} failed: {e}')

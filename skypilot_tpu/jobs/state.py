@@ -1,0 +1,218 @@
+"""Managed-job state machine (parity: sky/jobs/state.py:411).
+
+One sqlite table holds every managed job; the user-facing status enum
+mirrors the reference's ManagedJobStatus.  Transitions are guarded in SQL
+(single atomic UPDATE) so a cancel racing the controller can never be
+overwritten: terminal states are sticky, and CANCELLING can only move to
+CANCELLED or a FAILED_* state.
+"""
+from __future__ import annotations
+
+import enum
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.utils import db_utils
+
+
+class ManagedJobStatus(enum.Enum):
+    PENDING = 'PENDING'            # submitted, controller not started
+    STARTING = 'STARTING'          # controller provisioning the cluster
+    RUNNING = 'RUNNING'            # task running on its cluster
+    RECOVERING = 'RECOVERING'      # cluster lost (preemption); re-provision
+    CANCELLING = 'CANCELLING'      # user cancel observed, cleanup running
+    SUCCEEDED = 'SUCCEEDED'
+    FAILED = 'FAILED'              # user code exited non-zero
+    FAILED_SETUP = 'FAILED_SETUP'
+    FAILED_NO_RESOURCE = 'FAILED_NO_RESOURCE'  # placements exhausted
+    FAILED_CONTROLLER = 'FAILED_CONTROLLER'    # controller itself crashed
+    CANCELLED = 'CANCELLED'
+
+    def is_terminal(self) -> bool:
+        return self in _TERMINAL
+
+    def is_failed(self) -> bool:
+        return self in (ManagedJobStatus.FAILED,
+                        ManagedJobStatus.FAILED_SETUP,
+                        ManagedJobStatus.FAILED_NO_RESOURCE,
+                        ManagedJobStatus.FAILED_CONTROLLER)
+
+
+_TERMINAL = (ManagedJobStatus.SUCCEEDED, ManagedJobStatus.FAILED,
+             ManagedJobStatus.FAILED_SETUP,
+             ManagedJobStatus.FAILED_NO_RESOURCE,
+             ManagedJobStatus.FAILED_CONTROLLER, ManagedJobStatus.CANCELLED)
+
+
+def _db_path() -> str:
+    return os.path.expanduser(
+        os.environ.get('SKYTPU_JOBS_DB', '~/.skytpu/managed_jobs.db'))
+
+
+_DDL = [
+    """CREATE TABLE IF NOT EXISTS managed_jobs (
+        job_id INTEGER PRIMARY KEY AUTOINCREMENT,
+        name TEXT,
+        task_config TEXT,
+        status TEXT,
+        cluster_name TEXT,
+        cluster_job_id INTEGER,
+        submitted_at REAL,
+        started_at REAL,
+        ended_at REAL,
+        recovery_count INTEGER DEFAULT 0,
+        max_restarts_on_errors INTEGER DEFAULT 0,
+        restarts_on_errors INTEGER DEFAULT 0,
+        recovery_strategy TEXT DEFAULT 'FAILOVER',
+        failure_reason TEXT
+    )""",
+]
+
+
+def _ensure() -> str:
+    path = _db_path()
+    db_utils.ensure_schema(path, _DDL)
+    return path
+
+
+def log_path(job_id: int) -> str:
+    """Controller-side snapshot of the job's run log, persisted before the
+    ephemeral task cluster is torn down (parity: the reference controller
+    downloads logs, sky/jobs/controller.py:201)."""
+    return os.path.join(os.path.dirname(_db_path()), 'managed_jobs_logs',
+                        f'{job_id}.log')
+
+
+def submit(name: Optional[str], task_config: Dict[str, Any],
+           recovery_strategy: str = 'FAILOVER',
+           max_restarts_on_errors: int = 0) -> int:
+    path = _ensure()
+    with db_utils.transaction(path) as conn:
+        cur = conn.execute(
+            'INSERT INTO managed_jobs (name, task_config, status, '
+            'submitted_at, recovery_strategy, max_restarts_on_errors) '
+            'VALUES (?,?,?,?,?,?)',
+            (name, json.dumps(task_config),
+             ManagedJobStatus.PENDING.value, time.time(),
+             recovery_strategy, max_restarts_on_errors))
+        return int(cur.lastrowid)
+
+
+def set_status(job_id: int, status: ManagedJobStatus,
+               failure_reason: Optional[str] = None) -> bool:
+    """Guarded transition; returns False if the guard rejected it."""
+    path = _ensure()
+    now = time.time()
+    sets = ['status=?']
+    params: List[Any] = [status.value]
+    if status is ManagedJobStatus.RUNNING:
+        sets.append('started_at=COALESCE(started_at, ?)')
+        params.append(now)
+    if status.is_terminal():
+        sets.append('ended_at=?')
+        params.append(now)
+    if failure_reason is not None:
+        sets.append('failure_reason=?')
+        params.append(failure_reason)
+    params.append(job_id)
+    # Guards: terminal is sticky; CANCELLING only advances to terminal.
+    where = 'WHERE job_id=? AND status NOT IN ({})'.format(
+        ','.join('?' * len(_TERMINAL)))
+    params.extend(s.value for s in _TERMINAL)
+    if not status.is_terminal():
+        where += ' AND status != ?'
+        params.append(ManagedJobStatus.CANCELLING.value)
+    with db_utils.transaction(path) as conn:
+        cur = conn.execute(
+            f'UPDATE managed_jobs SET {", ".join(sets)} {where}',
+            tuple(params))
+        return cur.rowcount > 0
+
+
+def request_cancel(job_id: int) -> bool:
+    """User cancel: non-terminal -> CANCELLING.  Returns False if the job
+    is already terminal (or unknown)."""
+    path = _ensure()
+    params: List[Any] = [ManagedJobStatus.CANCELLING.value, job_id]
+    params.extend(s.value for s in _TERMINAL)
+    with db_utils.transaction(path) as conn:
+        cur = conn.execute(
+            'UPDATE managed_jobs SET status=? WHERE job_id=? AND status '
+            'NOT IN ({})'.format(','.join('?' * len(_TERMINAL))),
+            tuple(params))
+        return cur.rowcount > 0
+
+
+def set_cluster(job_id: int, cluster_name: str,
+                cluster_job_id: Optional[int]) -> None:
+    db_utils.execute(
+        _ensure(), 'UPDATE managed_jobs SET cluster_name=?, '
+        'cluster_job_id=? WHERE job_id=?',
+        (cluster_name, cluster_job_id, job_id))
+
+
+def bump_recovery_count(job_id: int) -> int:
+    path = _ensure()
+    with db_utils.transaction(path) as conn:
+        conn.execute(
+            'UPDATE managed_jobs SET recovery_count=recovery_count+1 '
+            'WHERE job_id=?', (job_id,))
+        row = conn.execute(
+            'SELECT recovery_count FROM managed_jobs WHERE job_id=?',
+            (job_id,)).fetchone()
+        return int(row[0]) if row else 0
+
+
+def bump_restarts_on_errors(job_id: int) -> int:
+    path = _ensure()
+    with db_utils.transaction(path) as conn:
+        conn.execute(
+            'UPDATE managed_jobs SET restarts_on_errors='
+            'restarts_on_errors+1 WHERE job_id=?', (job_id,))
+        row = conn.execute(
+            'SELECT restarts_on_errors FROM managed_jobs WHERE job_id=?',
+            (job_id,)).fetchone()
+        return int(row[0]) if row else 0
+
+
+def get(job_id: int) -> Optional[Dict[str, Any]]:
+    row = db_utils.query_one(
+        _ensure(), 'SELECT * FROM managed_jobs WHERE job_id=?', (job_id,))
+    return _row(row) if row else None
+
+
+def list_jobs(limit: int = 1000) -> List[Dict[str, Any]]:
+    rows = db_utils.query(
+        _ensure(),
+        'SELECT * FROM managed_jobs ORDER BY job_id DESC LIMIT ?',
+        (limit,))
+    return [_row(r) for r in rows]
+
+
+def nonterminal_jobs() -> List[Dict[str, Any]]:
+    params = tuple(s.value for s in _TERMINAL)
+    rows = db_utils.query(
+        _ensure(), 'SELECT * FROM managed_jobs WHERE status NOT IN ({}) '
+        'ORDER BY job_id'.format(','.join('?' * len(_TERMINAL))), params)
+    return [_row(r) for r in rows]
+
+
+def _row(row) -> Dict[str, Any]:
+    return {
+        'job_id': row['job_id'],
+        'name': row['name'],
+        'task_config': json.loads(row['task_config'] or '{}'),
+        'status': ManagedJobStatus(row['status']),
+        'cluster_name': row['cluster_name'],
+        'cluster_job_id': row['cluster_job_id'],
+        'submitted_at': row['submitted_at'],
+        'started_at': row['started_at'],
+        'ended_at': row['ended_at'],
+        'recovery_count': row['recovery_count'],
+        'max_restarts_on_errors': row['max_restarts_on_errors'],
+        'restarts_on_errors': row['restarts_on_errors'],
+        'recovery_strategy': row['recovery_strategy'],
+        'failure_reason': row['failure_reason'],
+    }

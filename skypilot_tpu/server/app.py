@@ -41,6 +41,15 @@ def make_app() -> web.Application:
 
     app.on_cleanup.append(on_cleanup)
 
+    async def on_startup(app):
+        # Re-adopt managed jobs orphaned by a server restart: their
+        # controller threads live in this process (consolidation mode).
+        from skypilot_tpu.jobs import controller as jobs_controller
+        await asyncio.get_event_loop().run_in_executor(
+            None, jobs_controller.maybe_start_controllers)
+
+    app.on_startup.append(on_startup)
+
     # ----- health / meta -----------------------------------------------------
     async def health(request):
         return web.json_response({'status': 'healthy',
@@ -135,13 +144,8 @@ def make_app() -> web.Application:
             None, lambda: core.cancel(cluster, job_id))
         return web.json_response({'cancelled': ok})
 
-    async def logs(request):
-        """Chunked log streaming: server tails the cluster agent and
-        relays (reference: CLI ← server ← cluster tail,
-        cloud_vm_ray_backend.py:4357)."""
-        cluster = request.match_info['cluster_name']
-        job_id = int(request.match_info['job_id'])
-        follow = request.query.get('follow', '1') == '1'
+    async def _stream_cluster_job_logs(request, cluster: str, job_id: int,
+                                       follow: bool):
         record = core._get_handle(cluster)  # pylint: disable=protected-access
         from skypilot_tpu.backends import TpuVmBackend
         backend = TpuVmBackend()
@@ -175,6 +179,62 @@ def make_app() -> web.Application:
             client.close()
             await resp.write_eof()
         return resp
+
+    async def logs(request):
+        """Chunked log streaming: server tails the cluster agent and
+        relays (reference: CLI ← server ← cluster tail,
+        cloud_vm_ray_backend.py:4357)."""
+        cluster = request.match_info['cluster_name']
+        job_id = int(request.match_info['job_id'])
+        follow = request.query.get('follow', '1') == '1'
+        return await _stream_cluster_job_logs(request, cluster, job_id,
+                                              follow)
+
+    # ----- managed jobs (controllers run consolidated in this process) -------
+    async def jobs_launch(request):
+        body = await request.json()
+        task = task_lib.Task.from_yaml_config(body['task'])
+        name = body.get('name')
+
+        def work():
+            from skypilot_tpu import jobs as jobs_lib
+            return {'job_id': jobs_lib.launch(task, name)}
+
+        request_id = request.app['executor'].submit(
+            'jobs_launch', body, work, long=False)
+        return web.json_response({'request_id': request_id})
+
+    async def jobs_queue(request):
+        from skypilot_tpu import jobs as jobs_lib
+        records = await asyncio.get_event_loop().run_in_executor(
+            None, jobs_lib.queue)
+        out = []
+        for r in records:
+            r = dict(r)
+            r['status'] = r['status'].value
+            out.append(r)
+        return web.json_response(out, dumps=lambda o: json.dumps(
+            o, default=str))
+
+    async def jobs_cancel(request):
+        body = await request.json()
+        from skypilot_tpu import jobs as jobs_lib
+        job_id = int(body['job_id'])
+        ok = await asyncio.get_event_loop().run_in_executor(
+            None, lambda: jobs_lib.cancel(job_id))
+        return web.json_response({'cancelled': ok})
+
+    async def jobs_logs(request):
+        from skypilot_tpu.jobs import state as jobs_state
+        job_id = int(request.match_info['job_id'])
+        follow = request.query.get('follow', '1') == '1'
+        rec = jobs_state.get(job_id)
+        if rec is None or rec['cluster_name'] is None or \
+                rec['cluster_job_id'] is None:
+            return web.json_response({'error': 'job logs unavailable'},
+                                     status=404)
+        return await _stream_cluster_job_logs(
+            request, rec['cluster_name'], rec['cluster_job_id'], follow)
 
     async def cost_report(request):
         report = await asyncio.get_event_loop().run_in_executor(
@@ -212,6 +272,10 @@ def make_app() -> web.Application:
     app.router.add_get('/queue/{cluster_name}', queue)
     app.router.add_post('/cancel', cancel)
     app.router.add_get('/logs/{cluster_name}/{job_id}', logs)
+    app.router.add_post('/jobs/launch', jobs_launch)
+    app.router.add_get('/jobs/queue', jobs_queue)
+    app.router.add_post('/jobs/cancel', jobs_cancel)
+    app.router.add_get('/jobs/logs/{job_id}', jobs_logs)
     app.router.add_get('/cost_report', cost_report)
     app.router.add_get('/accelerators', accelerators)
     app.router.add_get('/check', check)

@@ -134,3 +134,35 @@ def test_cli_entrypoints(api_server, tmp_path):
     assert 'clic' in result.output
     result = runner.invoke(cli, ['down', 'clic', '--yes'])
     assert result.exit_code == 0, result.output
+
+
+def test_managed_jobs_over_rest(api_server, monkeypatch):
+    """jobs launch -> queue -> logs -> terminal SUCCEEDED, all via REST.
+
+    The controller threads run inside the API-server process
+    (consolidation mode); the client only ever polls REST.
+    """
+    monkeypatch.setenv('SKYTPU_JOBS_POLL_INTERVAL', '0.25')
+    import io
+
+    from skypilot_tpu.client import sdk
+    result = sdk.get(sdk.jobs_launch(_mk_local_task('echo managed-rest'),
+                                     name='mjrest'))
+    job_id = result['job_id']
+    deadline = time.time() + 60
+    status = None
+    while time.time() < deadline:
+        recs = [r for r in sdk.jobs_queue() if r['job_id'] == job_id]
+        assert recs, 'job missing from queue'
+        status = recs[0]['status']
+        if status in ('SUCCEEDED', 'FAILED', 'FAILED_SETUP',
+                      'FAILED_NO_RESOURCE', 'FAILED_CONTROLLER',
+                      'CANCELLED'):
+            break
+        time.sleep(0.3)
+    assert status == 'SUCCEEDED', status
+    out = io.StringIO()
+    sdk.jobs_tail_logs(job_id, follow=False, out=out)
+    assert 'managed-rest' in out.getvalue()
+    # cancel of a finished job is a clean no-op over REST too
+    assert sdk.jobs_cancel(job_id) is False
