@@ -95,6 +95,13 @@ def set_status(job_id: int, status: JobStatus,
     if status is not JobStatus.CANCELLED:
         where += ' AND status != ?'
         params.append(JobStatus.CANCELLED.value)
+    else:
+        # ... and terminal results are sticky in the other direction too:
+        # a cancel racing job completion must not overwrite an
+        # already-recorded SUCCEEDED/FAILED/FAILED_SETUP.
+        where += ' AND status NOT IN (?,?,?)'
+        params.extend([JobStatus.SUCCEEDED.value, JobStatus.FAILED.value,
+                       JobStatus.FAILED_SETUP.value])
     db_utils.execute(path, f'UPDATE jobs SET {", ".join(sets)} {where}',
                      tuple(params))
 

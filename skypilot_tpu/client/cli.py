@@ -239,13 +239,28 @@ def jobs_launch(entrypoint, cluster, detach_run, **overrides):
     click.echo(f'Managed job {result["job_id"]} submitted.')
     if not detach_run:
         import time as _time
-        # Logs become available once the controller starts the job.
+        from skypilot_tpu.jobs.state import TERMINAL_STATUS_VALUES \
+            as _TERMINAL
+        # Logs become available once the controller starts the job — but a
+        # job can also fail terminally before it ever starts (e.g.
+        # FAILED_NO_RESOURCE), in which case there is nothing to tail.
+        rec = None
         for _ in range(600):
             recs = [r for r in sdk.jobs_queue()
                     if r['job_id'] == result['job_id']]
-            if recs and recs[0].get('cluster_job_id') is not None:
+            rec = recs[0] if recs else None
+            if rec is not None and (
+                    rec.get('cluster_job_id') is not None or
+                    rec.get('status') in _TERMINAL):
                 break
             _time.sleep(1)
+        if rec is not None and rec.get('status') in _TERMINAL and \
+                rec.get('cluster_job_id') is None:
+            reason = rec.get('failure_reason') or ''
+            click.echo(f'Managed job {result["job_id"]} finished with '
+                       f'status {rec["status"]}'
+                       f'{": " + reason if reason else ""}')
+            return
         sdk.jobs_tail_logs(result['job_id'])
 
 

@@ -48,6 +48,11 @@ def _poll_interval() -> float:
     return float(os.environ.get('SKYTPU_JOBS_POLL_INTERVAL', '10'))
 
 
+# Consecutive agent "no such job" polls on an UP cluster before the
+# controller declares the job lost and resubmits it.
+_LOST_JOB_POLLS = int(os.environ.get('SKYTPU_JOBS_LOST_JOB_POLLS', '6'))
+
+
 def cluster_name_for_job(job_id: int, name: Optional[str]) -> str:
     base = (name or 'task').lower().replace('_', '-')[:20].strip('-')
     return f'jobs-{job_id}-{base}'
@@ -62,23 +67,31 @@ class JobController:
 
     # ----- polling helpers ---------------------------------------------------
     def _cluster_job_status(self, cluster_name: str,
-                            cluster_job_id: int
-                            ) -> Optional[ClusterJobStatus]:
-        """Status of the job on its cluster, or None when the cluster/agent
-        cannot answer (candidate preemption)."""
+                            cluster_job_id: int):
+        """Status of the job on its cluster.
+
+        Returns a ClusterJobStatus, or one of two distinct non-answers:
+        UNREACHABLE (cluster record gone / agent did not answer —
+        candidate preemption, treated as transient while the cloud says
+        UP) or JOB_UNKNOWN (the agent answered but has no record of this
+        job id — its queue was lost, e.g. agent restarted; the job must
+        be resubmitted)."""
         record = global_user_state.get_cluster(cluster_name)
         if record is None:
-            return None
+            return self.UNREACHABLE
         client = self.backend._agent_client(record['handle'])  # pylint: disable=protected-access
         try:
             job = client.get_job(cluster_job_id)
         except Exception:  # pylint: disable=broad-except
-            return None
+            return self.UNREACHABLE
         finally:
             client.close()
         if job is None:
-            return None
+            return self.JOB_UNKNOWN
         return ClusterJobStatus(job['status'])
+
+    UNREACHABLE = object()
+    JOB_UNKNOWN = object()
 
     def _cancel_requested(self) -> bool:
         rec = state.get(self.job_id)
@@ -164,22 +177,31 @@ class JobController:
             state.set_cluster(job_id, cluster_name, cluster_job_id)
         state.set_status(job_id, ManagedJobStatus.RUNNING)
 
+        # An UP cluster whose agent answers but has no record of this job
+        # id (agent restarted and lost its queue) would otherwise poll
+        # forever; after _LOST_JOB_POLLS consecutive such answers we treat
+        # the job as lost and resubmit.  Mere unreachability does NOT
+        # count — the original job may still be running, and resubmitting
+        # over it would run two copies concurrently.
+        unknown_streak = 0
         while True:
             if self._cancel_requested():
                 self._finish_cancel(strategy, cluster_job_id)
                 return
             status = self._cluster_job_status(cluster_name, cluster_job_id)
             if status is ClusterJobStatus.SUCCEEDED:
-                state.set_status(job_id, ManagedJobStatus.SUCCEEDED)
+                # Snapshot before marking terminal: jobs-logs readers
+                # switch to the snapshot the moment the status flips.
                 self._snapshot_logs(cluster_name, cluster_job_id)
+                state.set_status(job_id, ManagedJobStatus.SUCCEEDED)
                 strategy.cleanup()
                 logger.info(f'Managed job {job_id} SUCCEEDED.')
                 return
             if status is ClusterJobStatus.CANCELLED:
                 # Cancelled out-of-band on the cluster itself.
+                self._snapshot_logs(cluster_name, cluster_job_id)
                 state.set_status(job_id, ManagedJobStatus.CANCELLED,
                                  'cluster job cancelled externally')
-                self._snapshot_logs(cluster_name, cluster_job_id)
                 strategy.cleanup()
                 return
             # Non-success: reconcile against cloud truth BEFORE judging.
@@ -190,6 +212,24 @@ class JobController:
             # recovery_strategy.should_restart_on_failure semantics +
             # backend_utils._update_cluster_status:2222.
             cl_status = backend_utils.refresh_cluster_status(cluster_name)
+            if cl_status is ClusterStatus.UP and \
+                    status is self.JOB_UNKNOWN:
+                unknown_streak += 1
+                if unknown_streak >= _LOST_JOB_POLLS:
+                    n = state.bump_recovery_count(job_id)
+                    logger.warning(
+                        f'Managed job {job_id}: cluster {cluster_name!r} '
+                        f'is UP but its agent has no record of job '
+                        f'{cluster_job_id} after {unknown_streak} polls; '
+                        f'resubmitting (recovery #{n}).')
+                    unknown_streak = 0
+                    state.set_status(job_id, ManagedJobStatus.RECOVERING)
+                    cluster_job_id = strategy.launch()
+                    state.set_cluster(job_id, cluster_name, cluster_job_id)
+                    state.set_status(job_id, ManagedJobStatus.RUNNING)
+                    continue
+            else:
+                unknown_streak = 0
             if cl_status is not ClusterStatus.UP:
                 n = state.bump_recovery_count(job_id)
                 logger.warning(
@@ -202,6 +242,7 @@ class JobController:
                 cluster_job_id = strategy.recover()
                 state.set_cluster(job_id, cluster_name, cluster_job_id)
                 state.set_status(job_id, ManagedJobStatus.RUNNING)
+                unknown_streak = 0
                 continue
             if status in (ClusterJobStatus.FAILED,
                           ClusterJobStatus.FAILED_SETUP):
@@ -212,11 +253,11 @@ class JobController:
                     final = (ManagedJobStatus.FAILED_SETUP if status is
                              ClusterJobStatus.FAILED_SETUP else
                              ManagedJobStatus.FAILED)
+                    self._snapshot_logs(cluster_name, cluster_job_id)
                     state.set_status(
                         job_id, final,
                         f'cluster job {cluster_job_id} '
                         f'{status.value} (restarted {n - 1}x)')
-                    self._snapshot_logs(cluster_name, cluster_job_id)
                     strategy.cleanup()
                     return
                 logger.info(
@@ -227,6 +268,7 @@ class JobController:
                 # launch reuses it and just resubmits the job.
                 state.set_cluster(job_id, cluster_name, cluster_job_id)
                 state.set_status(job_id, ManagedJobStatus.RUNNING)
+                unknown_streak = 0
                 continue
             # RUNNING / PENDING / SETTING_UP on a healthy cluster (or a
             # transient agent hiccup): poll again.

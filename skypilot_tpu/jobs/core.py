@@ -3,6 +3,7 @@ queue, cancel; logs via the task cluster's agent).
 """
 from __future__ import annotations
 
+import os
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu import exceptions
@@ -66,19 +67,45 @@ def cancel(job_id: int) -> bool:
     return ok
 
 
-def tail_logs(job_id: int, follow: bool = True) -> int:
+def snapshot_to_serve(rec: Dict[str, Any]) -> Optional[str]:
+    """Single place for the jobs-logs serving policy, shared by the REST
+    route and ``tail_logs``: once a job is terminal (its ephemeral
+    cluster is always torn down) or its cluster record is gone, logs are
+    served from the controller's snapshot (parity: the reference serves
+    downloaded logs controller-side, sky/jobs/controller.py:201).
+    Returns the snapshot path to serve, or None to use the live cluster.
+    """
+    record = None
+    if rec['cluster_name'] is not None:
+        record = global_user_state.get_cluster(rec['cluster_name'])
+    if rec['status'].is_terminal() or record is None:
+        snapshot = state.log_path(rec['job_id'])
+        if os.path.exists(snapshot):
+            return snapshot
+        if record is None:
+            raise exceptions.ClusterDoesNotExistError(
+                f'cluster for managed job {rec["job_id"]} is not up and '
+                f'no log snapshot exists '
+                f'(status={rec["status"].value})')
+    return None
+
+
+def tail_logs(job_id: int, follow: bool = True, out=None) -> int:
     rec = state.get(job_id)
     if rec is None:
         raise exceptions.JobNotFoundError(f'managed job {job_id}')
-    if rec['cluster_name'] is None or rec['cluster_job_id'] is None:
+    snapshot = snapshot_to_serve(rec)
+    if snapshot is not None:
+        import sys
+        stream = out or sys.stdout
+        with open(snapshot, 'r', errors='replace') as f:
+            stream.write(f.read())
+        return 0
+    if rec['cluster_job_id'] is None:
         raise exceptions.ClusterNotUpError(
             f'managed job {job_id} has not started yet '
             f'(status={rec["status"].value})')
     record = global_user_state.get_cluster(rec['cluster_name'])
-    if record is None:
-        raise exceptions.ClusterDoesNotExistError(
-            f'cluster for managed job {job_id} is not up '
-            f'(status={rec["status"].value})')
     from skypilot_tpu.backends import TpuVmBackend
     return TpuVmBackend().tail_logs(record['handle'],
                                     rec['cluster_job_id'], follow=follow)

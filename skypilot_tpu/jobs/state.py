@@ -45,6 +45,9 @@ _TERMINAL = (ManagedJobStatus.SUCCEEDED, ManagedJobStatus.FAILED,
              ManagedJobStatus.FAILED_NO_RESOURCE,
              ManagedJobStatus.FAILED_CONTROLLER, ManagedJobStatus.CANCELLED)
 
+# For clients that see statuses as wire strings (CLI/SDK over REST).
+TERMINAL_STATUS_VALUES = frozenset(s.value for s in _TERMINAL)
+
 
 def _db_path() -> str:
     return os.path.expanduser(

@@ -14,6 +14,7 @@ from typing import Any, Dict
 from aiohttp import web
 
 from skypilot_tpu import core
+from skypilot_tpu import global_user_state
 from skypilot_tpu import execution
 from skypilot_tpu import sky_logging
 from skypilot_tpu import task as task_lib
@@ -228,9 +229,25 @@ def make_app() -> web.Application:
         from skypilot_tpu.jobs import state as jobs_state
         job_id = int(request.match_info['job_id'])
         follow = request.query.get('follow', '1') == '1'
+        from skypilot_tpu import exceptions as exc
+        from skypilot_tpu.jobs import core as jobs_core
         rec = jobs_state.get(job_id)
-        if rec is None or rec['cluster_name'] is None or \
-                rec['cluster_job_id'] is None:
+        if rec is None:
+            return web.json_response({'error': 'job logs unavailable'},
+                                     status=404)
+        try:
+            snapshot = jobs_core.snapshot_to_serve(rec)
+        except exc.ClusterDoesNotExistError:
+            return web.json_response({'error': 'job logs unavailable'},
+                                     status=404)
+        if snapshot is not None:
+            def _read():
+                with open(snapshot, 'rb') as f:
+                    return f.read()
+            data = await asyncio.get_event_loop().run_in_executor(
+                None, _read)
+            return web.Response(body=data, content_type='text/plain')
+        if rec['cluster_job_id'] is None:
             return web.json_response({'error': 'job logs unavailable'},
                                      status=404)
         return await _stream_cluster_job_logs(
