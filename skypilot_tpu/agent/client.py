@@ -6,7 +6,6 @@ for TPU VMs the client opens `ssh -L` to the head host first.
 """
 from __future__ import annotations
 
-import socket
 import subprocess
 import time
 from typing import Any, Dict, List, Optional
@@ -15,14 +14,9 @@ import requests
 
 from skypilot_tpu import exceptions
 from skypilot_tpu.utils import command_runner as runner_lib
+from skypilot_tpu.utils import common_utils
 
 AGENT_PORT = 8790
-
-
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(('127.0.0.1', 0))
-        return s.getsockname()[1]
 
 
 class AgentClient:
@@ -35,7 +29,7 @@ class AgentClient:
         if direct or head_ip in ('127.0.0.1', 'localhost'):
             self._base = f'http://127.0.0.1:{agent_port}'
         else:
-            local_port = _free_port()
+            local_port = common_utils.find_free_port()
             runner = runner_lib.SSHCommandRunner(head_ip, ssh_user,
                                                  ssh_key_path)
             self._tunnel_proc = runner.tunnel(local_port, agent_port)

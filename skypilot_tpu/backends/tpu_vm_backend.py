@@ -12,7 +12,6 @@ from __future__ import annotations
 
 import os
 import shlex
-import socket
 import subprocess
 import sys
 import time
@@ -30,18 +29,13 @@ from skypilot_tpu.backends import backend as backend_lib
 from skypilot_tpu.global_user_state import ClusterHandle, ClusterStatus
 from skypilot_tpu.provision import failover
 from skypilot_tpu.provision.common import ProvisionConfig
+from skypilot_tpu.utils import common_utils
 from skypilot_tpu.utils import command_runner as runner_lib
 from skypilot_tpu.utils import locks
 
 logger = sky_logging.init_logger(__name__)
 
 _WORKDIR_DEST = '~/sky_workdir'
-
-
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(('127.0.0.1', 0))
-        return s.getsockname()[1]
 
 
 class TpuVmBackend(backend_lib.Backend):
@@ -158,7 +152,7 @@ class TpuVmBackend(backend_lib.Backend):
             ssh_user=info.ssh_user,
             ssh_key_path=os.path.expanduser('~/.ssh/sky-key')
             if candidate.cloud != 'local' else None,
-            agent_port=(_free_port() if candidate.cloud == 'local'
+            agent_port=(common_utils.find_free_port() if candidate.cloud == 'local'
                         else agent_client_lib.AGENT_PORT),
         )
         global_user_state.add_or_update_cluster(cluster_name, handle,

@@ -296,6 +296,62 @@ def jobs_logs_cmd(job_id, no_follow):
 
 
 @cli.group()
+def serve():
+    """Services: replicated, autoscaled, load-balanced endpoints."""
+
+
+@serve.command('up')
+@click.argument('entrypoint', nargs=-1)
+@click.option('--service-name', '-n', default=None)
+@_apply(_task_options)
+def serve_up_cmd(entrypoint, service_name, cluster, detach_run,
+                 **overrides):
+    """Bring up a service from a task YAML with a service: section."""
+    del cluster, detach_run
+    task = _load_task(entrypoint, **overrides)
+    result = sdk.get(sdk.serve_up(task, service_name))
+    click.echo(f'Service {result["name"]!r} starting; endpoint: '
+               f'{result["endpoint"]}')
+
+
+@serve.command('down')
+@click.argument('service_name')
+@click.option('--purge', is_flag=True, default=False,
+              help='Force-remove even if the controller is dead.')
+def serve_down_cmd(service_name, purge):
+    """Tear down a service (replicas, load balancer, controller)."""
+    sdk.get(sdk.serve_down(service_name, purge=purge))
+    click.echo(f'Service {service_name!r} is shutting down.')
+
+
+@serve.command('status')
+@click.argument('service_names', nargs=-1)
+def serve_status_cmd(service_names):
+    """Show services and their replicas."""
+    for svc in sdk.serve_status(list(service_names) or None):
+        click.echo(f'{svc["name"]}: {svc["status"]}  '
+                   f'endpoint={svc["endpoint"]}')
+        rows = []
+        for r in svc['replicas']:
+            rows.append([r['replica_id'], r['status'],
+                         r.get('url') or '-',
+                         r.get('zone') or '-',
+                         'spot' if r.get('is_spot') else 'on-demand'])
+        if rows:
+            ux_utils.print_table(
+                ['REPLICA', 'STATUS', 'URL', 'ZONE', 'KIND'], rows)
+
+
+@serve.command('logs')
+@click.argument('service_name')
+@click.argument('replica_id', type=int)
+@click.option('--follow', is_flag=True, default=False)
+def serve_logs_cmd(service_name, replica_id, follow):
+    """Stream one replica's workload logs."""
+    sdk.serve_replica_logs(service_name, replica_id, follow=follow)
+
+
+@cli.group()
 def api():
     """API server management."""
 

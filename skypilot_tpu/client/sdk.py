@@ -191,6 +191,42 @@ def jobs_tail_logs(job_id: int, follow: bool = True, out=None) -> None:
         out.flush()
 
 
+# ----- serve -----------------------------------------------------------------
+def serve_up(task: task_lib.Task,
+             service_name: Optional[str] = None) -> str:
+    return _post('/serve/up', {'task': task.to_yaml_config(),
+                               'name': service_name})['request_id']
+
+
+def serve_down(service_name: str, purge: bool = False) -> str:
+    return _post('/serve/down', {'name': service_name,
+                                 'purge': purge})['request_id']
+
+
+def serve_status(
+        service_names: Optional[List[str]] = None) -> List[Dict[str, Any]]:
+    params = {}
+    if service_names:
+        params['name'] = service_names
+    return _get('/serve/status', **params)
+
+
+def serve_replica_logs(service_name: str, replica_id: int,
+                       follow: bool = False, out=None) -> None:
+    ensure_server_running()
+    out = out or sys.stdout
+    resp = requests_lib.get(
+        f'{server_url()}/serve/logs/{service_name}/{replica_id}',
+        params={'follow': '1' if follow else '0'}, stream=True,
+        timeout=None)
+    if resp.status_code >= 400:
+        raise exceptions.ApiServerError(
+            f'serve logs failed ({resp.status_code}): {resp.text}')
+    for chunk in resp.iter_content(chunk_size=None):
+        out.write(chunk.decode(errors='replace'))
+        out.flush()
+
+
 def cost_report() -> List[Dict[str, Any]]:
     return _get('/cost_report')
 

@@ -143,6 +143,10 @@ class ManagedJobStatusError(SkyTpuError):
 
 
 # --- serve -------------------------------------------------------------------
+class ServeError(SkyTpuError):
+    """Serve operation failed (unknown service, duplicate name, ...)."""
+
+
 class ServeUserTerminatedError(SkyTpuError):
     """Service was torn down by the user while an operation was in flight."""
 

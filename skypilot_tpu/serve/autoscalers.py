@@ -1,0 +1,114 @@
+"""Autoscalers (capability parity: sky/serve/autoscalers.py —
+RequestRateAutoscaler :455, hysteresis :369).
+
+Pure decision logic, no I/O: the controller feeds it the request
+timestamps recorded by the load balancer plus current replica counts, and
+applies the returned delta.  That keeps it unit-testable over synthetic
+request traces (reference test: tests/test_serve_autoscaler.py).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from typing import List, Optional
+
+from skypilot_tpu.serve.service_spec import ServiceSpec
+
+# Seconds of request history the QPS estimate averages over.
+QPS_WINDOW_SECONDS = 60.0
+
+
+@dataclasses.dataclass
+class AutoscalerDecision:
+    """target - current delta the controller should apply this tick."""
+    target_num_replicas: int
+    delta: int  # >0 scale up by delta, <0 scale down by -delta, 0 hold
+
+
+class Autoscaler:
+    """Fixed-size policy: hold at min_replicas (spec without autoscaling)."""
+
+    def __init__(self, spec: ServiceSpec,
+                 qps_window_seconds: float = QPS_WINDOW_SECONDS) -> None:
+        self.spec = spec
+        self.qps_window_seconds = qps_window_seconds
+        self.target_num_replicas = spec.min_replicas
+
+    @classmethod
+    def make(cls, spec: ServiceSpec,
+             decision_interval_seconds: float,
+             qps_window_seconds: float = QPS_WINDOW_SECONDS) -> 'Autoscaler':
+        if spec.autoscaling_enabled:
+            return RequestRateAutoscaler(spec, decision_interval_seconds,
+                                         qps_window_seconds)
+        return cls(spec, qps_window_seconds)
+
+    def evaluate(self, request_timestamps: List[float],
+                 num_live_replicas: int,
+                 now: Optional[float] = None) -> AutoscalerDecision:
+        del request_timestamps, now
+        return AutoscalerDecision(
+            self.target_num_replicas,
+            self.target_num_replicas - num_live_replicas)
+
+
+class RequestRateAutoscaler(Autoscaler):
+    """Scale on measured QPS with hysteresis.
+
+    desired = ceil(qps / target_qps_per_replica), clamped to
+    [min_replicas, max_replicas].  A change of target only takes effect
+    after it has been sustained for upscale_delay_seconds (upscale) or
+    downscale_delay_seconds (downscale) — counted in whole decision
+    intervals, exactly the reference's upscale/downscale counter
+    hysteresis (sky/serve/autoscalers.py:369).
+    """
+
+    def __init__(self, spec: ServiceSpec,
+                 decision_interval_seconds: float,
+                 qps_window_seconds: float = QPS_WINDOW_SECONDS) -> None:
+        super().__init__(spec, qps_window_seconds)
+        assert spec.max_replicas is not None
+        assert spec.target_qps_per_replica is not None
+        self.decision_interval_seconds = decision_interval_seconds
+        self.upscale_threshold = max(
+            1, int(math.ceil(spec.upscale_delay_seconds /
+                             decision_interval_seconds)))
+        self.downscale_threshold = max(
+            1, int(math.ceil(spec.downscale_delay_seconds /
+                             decision_interval_seconds)))
+        self.upscale_counter = 0
+        self.downscale_counter = 0
+
+    def current_qps(self, request_timestamps: List[float],
+                    now: Optional[float] = None) -> float:
+        now = time.time() if now is None else now
+        cutoff = now - self.qps_window_seconds
+        n = sum(1 for t in request_timestamps if t >= cutoff)
+        return n / self.qps_window_seconds
+
+    def evaluate(self, request_timestamps: List[float],
+                 num_live_replicas: int,
+                 now: Optional[float] = None) -> AutoscalerDecision:
+        qps = self.current_qps(request_timestamps, now)
+        desired = int(math.ceil(qps / self.spec.target_qps_per_replica))
+        desired = max(self.spec.min_replicas,
+                      min(self.spec.max_replicas, desired))
+        if desired > self.target_num_replicas:
+            self.upscale_counter += 1
+            self.downscale_counter = 0
+            if self.upscale_counter >= self.upscale_threshold:
+                self.target_num_replicas = desired
+                self.upscale_counter = 0
+        elif desired < self.target_num_replicas:
+            self.downscale_counter += 1
+            self.upscale_counter = 0
+            if self.downscale_counter >= self.downscale_threshold:
+                self.target_num_replicas = desired
+                self.downscale_counter = 0
+        else:
+            self.upscale_counter = 0
+            self.downscale_counter = 0
+        return AutoscalerDecision(
+            self.target_num_replicas,
+            self.target_num_replicas - num_live_replicas)

@@ -1,0 +1,174 @@
+"""Serve controller: one thread per service driving autoscaler decisions
+into the replica manager (capability parity: sky/serve/controller.py +
+sky/serve/service.py — controller loop; consolidation like managed jobs:
+the controller runs inside the process that owns the serve DB, the same
+argument as jobs/controller.py).
+
+Each service gets a controller thread + an in-process load balancer; both
+are re-adopted by maybe_start_controllers() after an API-server restart
+(replica clusters and the serve DB survive; only the threads die).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+from skypilot_tpu import catalog
+from skypilot_tpu import sky_logging
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.autoscalers import Autoscaler
+from skypilot_tpu.serve.load_balancer import LoadBalancer
+from skypilot_tpu.serve.load_balancing_policies import LoadBalancingPolicy
+from skypilot_tpu.serve.replica_managers import ReplicaManager
+from skypilot_tpu.serve.serve_state import ReplicaStatus, ServiceStatus
+from skypilot_tpu.serve.service_spec import ServiceSpec
+from skypilot_tpu.serve.spot_placer import SpotPlacer
+
+logger = sky_logging.init_logger(__name__)
+
+
+def _tick_interval() -> float:
+    return float(os.environ.get('SKYTPU_SERVE_TICK_INTERVAL', '10'))
+
+
+def _qps_window() -> float:
+    return float(os.environ.get('SKYTPU_SERVE_QPS_WINDOW', '60'))
+
+
+class ServiceController:
+    """Drives one service: LB + probe/reconcile + autoscale until DOWN."""
+
+    def __init__(self, service_name: str) -> None:
+        rec = serve_state.get_service(service_name)
+        assert rec is not None, service_name
+        self.service_name = service_name
+        self.spec = ServiceSpec.from_yaml_config(rec['spec'])
+        self.task = task_lib.Task.from_yaml_config(rec['task_config'])
+        placer: Optional[SpotPlacer] = None
+        if self.task.any_resources.use_spot:
+            try:
+                zones = catalog.get_zones(self.task.any_resources)
+            except Exception:  # pylint: disable=broad-except
+                zones = []
+            placer = SpotPlacer(zones)
+        self.manager = ReplicaManager(service_name, self.spec, self.task,
+                                      spot_placer=placer)
+        self.lb = LoadBalancer(
+            service_name, rec['lb_port'],
+            LoadBalancingPolicy.make(self.spec.load_balancing_policy),
+            self.manager.ready_urls)
+        self.autoscaler = Autoscaler.make(self.spec, _tick_interval(),
+                                          _qps_window())
+
+    def run(self) -> None:
+        try:
+            self.lb.start()
+        except Exception as e:  # pylint: disable=broad-except
+            logger.exception(f'Service {self.service_name!r}: load '
+                             f'balancer failed to start')
+            serve_state.set_service_status(self.service_name,
+                                           ServiceStatus.FAILED, repr(e))
+            return
+        try:
+            self._run_inner()
+        except Exception as e:  # pylint: disable=broad-except
+            logger.exception(f'Service {self.service_name!r}: controller '
+                             f'crashed')
+            serve_state.set_service_status(self.service_name,
+                                           ServiceStatus.FAILED, repr(e))
+        finally:
+            self.lb.stop()
+
+    def _run_inner(self) -> None:
+        while True:
+            rec = serve_state.get_service(self.service_name)
+            if rec is None or rec['status'] is ServiceStatus.SHUTTING_DOWN:
+                logger.info(f'Service {self.service_name!r}: shutting '
+                            f'down, terminating replicas.')
+                self.manager.terminate_all()
+                serve_state.set_service_status(self.service_name,
+                                               ServiceStatus.SHUTDOWN)
+                return
+            now = time.time()
+            self.manager.probe_and_reconcile(now)
+            decision = self.autoscaler.evaluate(
+                list(self.lb.request_timestamps), self.manager.num_live(),
+                now)
+            if decision.delta > 0:
+                logger.info(f'Service {self.service_name!r}: scaling up '
+                            f'by {decision.delta} to '
+                            f'{decision.target_num_replicas}.')
+                self.manager.scale_up(decision.delta)
+            elif decision.delta < 0:
+                logger.info(f'Service {self.service_name!r}: scaling '
+                            f'down by {-decision.delta} to '
+                            f'{decision.target_num_replicas}.')
+                self.manager.scale_down(-decision.delta)
+            self._update_service_status()
+            time.sleep(_tick_interval())
+
+    def _update_service_status(self) -> None:
+        rec = serve_state.get_service(self.service_name)
+        if rec is None or rec['status'] in (ServiceStatus.SHUTTING_DOWN,
+                                            ServiceStatus.SHUTDOWN,
+                                            ServiceStatus.FAILED):
+            return
+        replicas = serve_state.get_replicas(self.service_name)
+        any_ready = any(r['status'] is ReplicaStatus.READY
+                        for r in replicas)
+        if any_ready:
+            new = ServiceStatus.READY
+        elif rec['status'] is ServiceStatus.STARTING:
+            new = ServiceStatus.STARTING  # still bringing up the first one
+        else:
+            new = ServiceStatus.NO_REPLICA
+        if new is not rec['status']:
+            serve_state.set_service_status(self.service_name, new)
+
+
+# ----- controller manager -----------------------------------------------------
+
+_manager_lock = threading.Lock()
+_controllers: Dict[str, threading.Thread] = {}
+
+
+def maybe_start_controllers() -> None:
+    """Start controller threads for live services (startup re-adoption +
+    serve-up hook), mirroring jobs.controller.maybe_start_controllers."""
+    with _manager_lock:
+        for rec in serve_state.list_services():
+            name = rec['name']
+            if rec['status'].is_terminal():
+                continue
+            th = _controllers.get(name)
+            if th is not None and th.is_alive():
+                continue
+            th = threading.Thread(target=ServiceController(name).run,
+                                  name=f'serve-controller-{name}',
+                                  daemon=True)
+            _controllers[name] = th
+            th.start()
+
+
+def controller_alive(service_name: str) -> bool:
+    with _manager_lock:
+        th = _controllers.get(service_name)
+        return th is not None and th.is_alive()
+
+
+def wait_service_status(service_name: str, statuses,
+                        timeout_s: float = 120.0) -> ServiceStatus:
+    """Block until the service reaches one of `statuses` (test helper)."""
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        rec = serve_state.get_service(service_name)
+        if rec is not None and rec['status'] in statuses:
+            return rec['status']
+        time.sleep(0.2)
+    rec = serve_state.get_service(service_name)
+    raise TimeoutError(
+        f'service {service_name!r} never reached {statuses}; at '
+        f'{rec["status"] if rec else None}')

@@ -1,0 +1,109 @@
+"""Serve user API: up / down / status / replica logs
+(capability parity: sky/serve/server/core.py up :28, down, status).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Union
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import sky_logging
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.serve import controller as controller_lib
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.serve_state import ServiceStatus
+from skypilot_tpu.serve.service_spec import ServiceSpec
+from skypilot_tpu.utils import common_utils
+
+logger = sky_logging.init_logger(__name__)
+
+
+def up(task: task_lib.Task, service_name: Optional[str] = None,
+       lb_port: Optional[int] = None) -> Dict[str, Any]:
+    """Bring up a service; returns {'name', 'endpoint'}.
+
+    The task must carry a `service:` section (readiness probe +
+    replica policy).  The controller and load balancer run consolidated
+    in this process (see serve/controller.py).
+    """
+    if task.service is None:
+        raise exceptions.InvalidTaskError(
+            'task has no `service:` section; add a readiness_probe and '
+            'replica policy to serve it')
+    spec = ServiceSpec.from_yaml_config(task.service)
+    name = service_name or task.name or 'service'
+    task_lib.Task(name)  # name validation
+    port = lb_port if lb_port is not None else \
+        common_utils.find_free_port()
+    if not serve_state.add_service(name, spec.to_yaml_config(),
+                                   task.to_yaml_config(), port):
+        raise exceptions.ServeError(
+            f'service {name!r} already exists; `serve down {name}` first '
+            f'or pick another name')
+    controller_lib.maybe_start_controllers()
+    endpoint = f'http://127.0.0.1:{port}'
+    logger.info(f'Service {name!r} starting; endpoint: {endpoint}')
+    return {'name': name, 'endpoint': endpoint}
+
+
+def down(service_name: str, purge: bool = False) -> None:
+    """Tear a service down: replicas, LB, controller.
+
+    purge: force-remove the record even if the controller is dead and
+    cannot run the shutdown itself.
+    """
+    rec = serve_state.get_service(service_name)
+    if rec is None:
+        raise exceptions.ServeError(f'service {service_name!r} not found')
+    if rec['status'].is_terminal():
+        serve_state.remove_service(service_name)
+        return
+    serve_state.set_service_status(service_name,
+                                   ServiceStatus.SHUTTING_DOWN)
+    # The controller thread observes SHUTTING_DOWN and cleans up; if it
+    # died (or we're a fresh process after a restart), re-adopt so the
+    # shutdown actually runs.
+    controller_lib.maybe_start_controllers()
+    if purge:
+        from skypilot_tpu.serve.replica_managers import ReplicaManager
+        spec = ServiceSpec.from_yaml_config(rec['spec'])
+        t = task_lib.Task.from_yaml_config(rec['task_config'])
+        ReplicaManager(service_name, spec, t).terminate_all()
+        serve_state.remove_service(service_name)
+
+
+def status(service_names: Optional[Union[str, List[str]]] = None
+           ) -> List[Dict[str, Any]]:
+    """Services + their replicas (parity: sky serve status)."""
+    if isinstance(service_names, str):
+        service_names = [service_names]
+    out = []
+    for rec in serve_state.list_services():
+        if service_names and rec['name'] not in service_names:
+            continue
+        replicas = serve_state.get_replicas(rec['name'],
+                                            include_terminal=True)
+        out.append({
+            'name': rec['name'],
+            'status': rec['status'],
+            'endpoint': f'http://127.0.0.1:{rec["lb_port"]}',
+            'failure_reason': rec['failure_reason'],
+            'replicas': replicas,
+        })
+    return out
+
+
+def tail_replica_logs(service_name: str, replica_id: int,
+                      follow: bool = False) -> int:
+    rec = serve_state.get_replica(service_name, replica_id)
+    if rec is None:
+        raise exceptions.ServeError(
+            f'replica {replica_id} of service {service_name!r} not found')
+    record = global_user_state.get_cluster(rec['cluster_name'])
+    if record is None or rec['cluster_job_id'] is None:
+        raise exceptions.ClusterDoesNotExistError(
+            f'replica {replica_id} of {service_name!r} has no live '
+            f'cluster (status={rec["status"].value})')
+    from skypilot_tpu.backends import TpuVmBackend
+    return TpuVmBackend().tail_logs(record['handle'],
+                                    rec['cluster_job_id'], follow=follow)

@@ -1,0 +1,136 @@
+"""HTTP load balancer: reverse proxy in front of a service's replicas
+(capability parity: sky/serve/load_balancer.py:24).
+
+One LB per service, running an aiohttp server on its own thread + event
+loop so it works identically library-direct and inside the API server.
+Every proxied request is timestamped; the autoscaler reads that trace to
+estimate QPS.
+"""
+from __future__ import annotations
+
+import asyncio
+import collections
+import threading
+import time
+from typing import Callable, Deque, List, Optional
+
+import aiohttp
+from aiohttp import web
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.serve.load_balancing_policies import LoadBalancingPolicy
+
+logger = sky_logging.init_logger(__name__)
+
+# Request timestamps kept for QPS estimation (bounded memory).
+_MAX_TIMESTAMPS = 100_000
+_HOP_HEADERS = {'connection', 'keep-alive', 'transfer-encoding', 'host',
+                'proxy-authenticate', 'proxy-authorization', 'te',
+                'trailers', 'upgrade'}
+
+
+class LoadBalancer:
+
+    def __init__(self, service_name: str, port: int,
+                 policy: LoadBalancingPolicy,
+                 ready_urls_fn: Callable[[], List[str]]) -> None:
+        self.service_name = service_name
+        self.port = port
+        self.policy = policy
+        self._ready_urls_fn = ready_urls_fn
+        self.request_timestamps: Deque[float] = collections.deque(
+            maxlen=_MAX_TIMESTAMPS)
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._started = threading.Event()
+        self._runner: Optional[web.AppRunner] = None
+        # One pooled session for the proxy hot path, created on the LB's
+        # own event loop and closed in stop().
+        self._session: Optional[aiohttp.ClientSession] = None
+
+    # ----- data plane ---------------------------------------------------------
+    async def _handle(self, request: web.Request) -> web.StreamResponse:
+        self.request_timestamps.append(time.time())
+        urls = self._ready_urls_fn()
+        url = self.policy.select(urls)
+        if url is None:
+            return web.json_response(
+                {'error': f'no ready replicas for {self.service_name}'},
+                status=503)
+        target = url.rstrip('/') + '/' + str(request.rel_url).lstrip('/')
+        self.policy.on_request_start(url)
+        try:
+            headers = {k: v for k, v in request.headers.items()
+                       if k.lower() not in _HOP_HEADERS}
+            body = await request.read()
+            assert self._session is not None
+            async with self._session.request(
+                    request.method, target, headers=headers,
+                    data=body if body else None,
+                    allow_redirects=False) as upstream:
+                resp = web.StreamResponse(status=upstream.status)
+                for k, v in upstream.headers.items():
+                    if k.lower() not in _HOP_HEADERS and \
+                            k.lower() != 'content-length':
+                        resp.headers[k] = v
+                await resp.prepare(request)
+                async for chunk in upstream.content.iter_chunked(
+                        64 * 1024):
+                    await resp.write(chunk)
+                await resp.write_eof()
+                return resp
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+            logger.warning(f'LB {self.service_name}: replica {url} '
+                           f'errored: {e}')
+            return web.json_response(
+                {'error': f'replica request failed: {e}'}, status=502)
+        finally:
+            self.policy.on_request_end(url)
+
+    # ----- lifecycle ----------------------------------------------------------
+    def start(self) -> None:
+        assert self._thread is None, 'LB already started'
+        self._thread = threading.Thread(
+            target=self._serve_forever,
+            name=f'serve-lb-{self.service_name}', daemon=True)
+        self._thread.start()
+        if not self._started.wait(timeout=10.0):
+            raise RuntimeError(
+                f'load balancer for {self.service_name!r} failed to start')
+
+    def _serve_forever(self) -> None:
+        loop = asyncio.new_event_loop()
+        self._loop = loop
+        asyncio.set_event_loop(loop)
+
+        async def _start():
+            self._session = aiohttp.ClientSession()
+            app = web.Application()
+            app.router.add_route('*', '/{tail:.*}', self._handle)
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, '0.0.0.0', self.port)
+            await site.start()
+            return runner
+
+        self._runner = loop.run_until_complete(_start())
+        self._started.set()
+        try:
+            loop.run_forever()
+        finally:
+            loop.run_until_complete(self._session.close())
+            loop.run_until_complete(self._runner.cleanup())
+            loop.close()
+
+    def stop(self) -> None:
+        loop, thread = self._loop, self._thread
+        if loop is None or thread is None:
+            return
+        loop.call_soon_threadsafe(loop.stop)
+        thread.join(timeout=10.0)
+        self._loop = None
+        self._thread = None
+
+    @property
+    def endpoint(self) -> str:
+        return f'http://127.0.0.1:{self.port}'

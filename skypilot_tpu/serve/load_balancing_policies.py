@@ -1,0 +1,88 @@
+"""Load-balancing policies (capability parity:
+sky/serve/load_balancing_policies.py — round_robin :85, least_load :111).
+
+A policy picks a replica URL from the ready set; the load balancer calls
+`select` per request and reports completion so least_load can track
+outstanding requests.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Dict, List, Optional
+
+
+class LoadBalancingPolicy:
+    NAME = 'abstract'
+
+    def select(self, ready_urls: List[str]) -> Optional[str]:
+        raise NotImplementedError
+
+    def on_request_start(self, url: str) -> None:
+        pass
+
+    def on_request_end(self, url: str) -> None:
+        pass
+
+    @staticmethod
+    def make(name: str) -> 'LoadBalancingPolicy':
+        impl = _POLICIES.get(name)
+        if impl is None:
+            raise ValueError(f'unknown load_balancing_policy {name!r}; '
+                             f'choose from {sorted(_POLICIES)}')
+        return impl()
+
+
+class RoundRobinPolicy(LoadBalancingPolicy):
+    NAME = 'round_robin'
+
+    def __init__(self) -> None:
+        self._counter = itertools.count()
+
+    def select(self, ready_urls: List[str]) -> Optional[str]:
+        if not ready_urls:
+            return None
+        return ready_urls[next(self._counter) % len(ready_urls)]
+
+
+class LeastLoadPolicy(LoadBalancingPolicy):
+    """Route to the replica with the fewest outstanding requests (the
+    reference's default)."""
+    NAME = 'least_load'
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._outstanding: Dict[str, int] = {}
+
+    def select(self, ready_urls: List[str]) -> Optional[str]:
+        if not ready_urls:
+            return None
+        with self._lock:
+            return min(ready_urls,
+                       key=lambda u: self._outstanding.get(u, 0))
+
+    def on_request_start(self, url: str) -> None:
+        with self._lock:
+            self._outstanding[url] = self._outstanding.get(url, 0) + 1
+
+    def on_request_end(self, url: str) -> None:
+        with self._lock:
+            n = self._outstanding.get(url, 0)
+            if n <= 1:
+                self._outstanding.pop(url, None)
+            else:
+                self._outstanding[url] = n - 1
+
+
+class InstanceAwarePolicy(LeastLoadPolicy):
+    """Least-load weighted by replica capacity (reference :151 weights by
+    instance size; here every TPU replica of one service has the same
+    slice shape, so this degenerates to least_load — kept as its own name
+    for spec parity)."""
+    NAME = 'instance_aware'
+
+
+_POLICIES = {
+    p.NAME: p
+    for p in (RoundRobinPolicy, LeastLoadPolicy, InstanceAwarePolicy)
+}

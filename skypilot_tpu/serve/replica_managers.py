@@ -1,0 +1,325 @@
+"""Replica manager: launches, probes, and replaces replica clusters
+(capability parity: sky/serve/replica_managers.py:731
+SkyPilotReplicaManager — launch via execution.launch, readiness probing
+:571-654, preemption handling :1073).
+
+Each replica is an ordinary cluster launched through the same
+execution.launch path users get, with the workload told where to listen
+via SKYTPU_SERVE_REPLICA_PORT.  Preemption is detected exactly like
+managed jobs: reconcile the state DB against cloud truth
+(backend_utils.refresh_cluster_status), then delete the stale slice and
+let the autoscaler's next tick replace it.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import requests as requests_lib
+
+from skypilot_tpu import execution
+from skypilot_tpu import global_user_state
+from skypilot_tpu import sky_logging
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.backends import TpuVmBackend
+from skypilot_tpu.backends import backend_utils
+from skypilot_tpu.global_user_state import ClusterStatus
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.serve_state import ReplicaStatus
+from skypilot_tpu.serve.service_spec import ServiceSpec
+from skypilot_tpu.serve.spot_placer import SpotPlacer
+from skypilot_tpu.utils import common_utils
+
+logger = sky_logging.init_logger(__name__)
+
+# Consecutive probe failures before READY -> NOT_READY.
+_NOT_READY_THRESHOLD = 3
+# Consecutive probe failures before a NOT_READY replica is replaced.
+_REPLACE_THRESHOLD = 12
+
+ENV_REPLICA_PORT = 'SKYTPU_SERVE_REPLICA_PORT'
+ENV_REPLICA_ID = 'SKYTPU_SERVE_REPLICA_ID'
+ENV_SERVICE_NAME = 'SKYTPU_SERVE_SERVICE_NAME'
+
+
+class ReplicaManager:
+
+    def __init__(self, service_name: str, spec: ServiceSpec,
+                 task: task_lib.Task,
+                 spot_placer: Optional[SpotPlacer] = None) -> None:
+        self.service_name = service_name
+        self.spec = spec
+        self.task = task
+        self.spot_placer = spot_placer
+        self.backend = TpuVmBackend()
+        self._launch_threads: Dict[int, threading.Thread] = {}
+        # replica_id -> consecutive probe failures
+        self._probe_failures: Dict[int, int] = {}
+        self._lock = threading.Lock()
+
+    # ----- naming -------------------------------------------------------------
+    def _cluster_name(self, replica_id: int) -> str:
+        return f'serve-{self.service_name}-{replica_id}'
+
+    # ----- scale up -----------------------------------------------------------
+    def _next_is_spot(self) -> bool:
+        """Spot-or-on-demand for the next replica (reference: autoscaler
+        ondemand fallback, sky/serve/autoscalers.py).
+
+        On-demand when: the task isn't spot at all; the first
+        base_ondemand_fallback_replicas slots aren't covered by live
+        on-demand replicas; or dynamic_ondemand_fallback is on and every
+        known zone has recently preempted us (spot capacity demonstrably
+        gone — bridge on on-demand until it returns)."""
+        if not self.task.any_resources.use_spot:
+            return False
+        live = serve_state.get_replicas(self.service_name)
+        ondemand_live = sum(1 for r in live if not r['is_spot'])
+        if ondemand_live < self.spec.base_ondemand_fallback_replicas:
+            return False
+        if self.spec.dynamic_ondemand_fallback and \
+                self.spot_placer is not None and \
+                not self.spot_placer.active_zones() and \
+                self.spot_placer.preempted_zones():
+            return False
+        return True
+
+    def scale_up(self, n: int) -> None:
+        for _ in range(n):
+            replica_id = serve_state.next_replica_id(self.service_name)
+            is_spot = self._next_is_spot()
+            zone = None
+            if is_spot and self.spot_placer is not None:
+                zone = self.spot_placer.select()
+            serve_state.add_replica(
+                self.service_name, replica_id,
+                self._cluster_name(replica_id),
+                is_spot=is_spot, zone=zone)
+            th = threading.Thread(
+                target=self._launch_replica,
+                args=(replica_id, zone, is_spot),
+                name=f'serve-launch-{self.service_name}-{replica_id}',
+                daemon=True)
+            with self._lock:
+                self._launch_threads[replica_id] = th
+            th.start()
+
+    def _replica_task(self, replica_id: int, port: int,
+                      zone: Optional[str],
+                      is_spot: bool) -> task_lib.Task:
+        task = task_lib.Task.from_yaml_config(self.task.to_yaml_config())
+        task.service = None  # the replica runs the workload, not a service
+        task.update_envs({
+            ENV_REPLICA_PORT: str(port),
+            ENV_REPLICA_ID: str(replica_id),
+            ENV_SERVICE_NAME: self.service_name,
+        })
+        res = task.any_resources
+        overrides = {}
+        if res.use_spot and not is_spot:
+            overrides['use_spot'] = False  # on-demand fallback replica
+        if zone is not None:
+            overrides['infra'] = (
+                f'{res.cloud}/{zone.rsplit("-", 1)[0]}/{zone}'
+                if res.cloud else zone)
+        if overrides:
+            task.set_resources(res.copy(**overrides))
+        return task
+
+    def _pick_port(self) -> int:
+        res = self.task.any_resources
+        if res.cloud == 'local' or res.cloud is None:
+            # Replicas share this host; every one needs its own port.
+            return common_utils.find_free_port()
+        if res.ports:
+            return int(str(res.ports[0]).split('-')[0])
+        return 8080
+
+    def _launch_replica(self, replica_id: int, zone: Optional[str],
+                        is_spot: bool) -> None:
+        cluster = self._cluster_name(replica_id)
+        port = self._pick_port()
+        try:
+            task = self._replica_task(replica_id, port, zone, is_spot)
+            job_id, handle = execution.launch(
+                task, cluster, detach_run=True, quiet_optimizer=True)
+            url = f'http://{handle.head_ip}:{port}'
+            serve_state.set_replica_endpoint(self.service_name, replica_id,
+                                             url, job_id)
+            # Guarded: if the replica was terminated while we were
+            # provisioning (scale-down or serve down racing the launch),
+            # do not resurrect it — tear the fresh cluster down instead.
+            if not serve_state.set_replica_status_if(
+                    self.service_name, replica_id,
+                    ReplicaStatus.PROVISIONING, ReplicaStatus.STARTING):
+                logger.info(f'Service {self.service_name!r}: replica '
+                            f'{replica_id} was terminated mid-provision; '
+                            f'tearing its cluster down.')
+                self._teardown_cluster(cluster)
+                if self.spot_placer is not None:
+                    self.spot_placer.handle_termination(zone)
+                return
+            logger.info(f'Service {self.service_name!r}: replica '
+                        f'{replica_id} provisioned at {url}')
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning(f'Service {self.service_name!r}: replica '
+                           f'{replica_id} failed to provision: {e}')
+            serve_state.set_replica_status_if(
+                self.service_name, replica_id, ReplicaStatus.PROVISIONING,
+                ReplicaStatus.FAILED)
+            self._teardown_cluster(cluster)
+            if self.spot_placer is not None:
+                self.spot_placer.handle_termination(zone)
+
+    # ----- scale down / terminate ---------------------------------------------
+    def scale_down(self, n: int) -> None:
+        """Terminate n replicas, least-useful first: non-ready before
+        ready, then newest first (reference scales down newest)."""
+        replicas = serve_state.get_replicas(self.service_name)
+        order = sorted(
+            replicas,
+            key=lambda r: (r['status'] is ReplicaStatus.READY,
+                           -r['replica_id']))
+        for rec in order[:n]:
+            self.terminate_replica(rec['replica_id'])
+
+    def terminate_replica(self, replica_id: int,
+                          preempted: bool = False) -> None:
+        rec = serve_state.get_replica(self.service_name, replica_id)
+        if rec is None or rec['status'].is_terminal():
+            return
+        serve_state.set_replica_status(self.service_name, replica_id,
+                                       ReplicaStatus.SHUTTING_DOWN)
+        self._teardown_cluster(rec['cluster_name'])
+        final = (ReplicaStatus.PREEMPTED if preempted
+                 else ReplicaStatus.SHUTDOWN)
+        serve_state.set_replica_status(self.service_name, replica_id,
+                                       final)
+        if self.spot_placer is not None and rec['is_spot']:
+            if preempted:
+                self.spot_placer.handle_preemption(rec['zone'])
+            else:
+                self.spot_placer.handle_termination(rec['zone'])
+        self._probe_failures.pop(replica_id, None)
+
+    def terminate_all(self) -> None:
+        for rec in serve_state.get_replicas(self.service_name):
+            self.terminate_replica(rec['replica_id'])
+
+    def _teardown_cluster(self, cluster_name: str) -> None:
+        record = global_user_state.get_cluster(cluster_name)
+        if record is None:
+            return
+        try:
+            self.backend.teardown(record['handle'], terminate=True)
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning(f'teardown of replica cluster '
+                           f'{cluster_name!r} failed: {e}')
+            if global_user_state.get_cluster(cluster_name) is not None:
+                global_user_state.remove_cluster(cluster_name)
+
+    # ----- probing / reconciliation -------------------------------------------
+    def _probe_url(self, url: str) -> bool:
+        probe = self.spec.readiness_probe
+        target = url.rstrip('/') + probe.path
+        try:
+            if probe.post_data is not None:
+                resp = requests_lib.post(target, json=probe.post_data,
+                                         timeout=probe.timeout_seconds)
+            else:
+                resp = requests_lib.get(target,
+                                        timeout=probe.timeout_seconds)
+            return 200 <= resp.status_code < 300
+        except requests_lib.RequestException:
+            return False
+
+    def probe_and_reconcile(self, now: float) -> None:
+        """One controller tick: detect preemptions, probe readiness,
+        replace replicas that failed their probes for too long."""
+        for rec in serve_state.get_replicas(self.service_name):
+            rid = rec['replica_id']
+            status = rec['status']
+            if status is ReplicaStatus.PROVISIONING or \
+                    status is ReplicaStatus.SHUTTING_DOWN:
+                continue
+            # Cloud-truth reconcile first: a preempted slice must be
+            # deleted and replaced, not probed.
+            cl_status = backend_utils.refresh_cluster_status(
+                rec['cluster_name'])
+            if cl_status is not ClusterStatus.UP:
+                logger.warning(
+                    f'Service {self.service_name!r}: replica {rid} '
+                    f'cluster lost (status={cl_status}); replacing.')
+                self.terminate_replica(rid, preempted=True)
+                continue
+            # Workload exited? A dead server process is a failure even if
+            # the cluster is healthy.
+            if rec['cluster_job_id'] is not None and \
+                    self._job_failed(rec):
+                logger.warning(f'Service {self.service_name!r}: replica '
+                               f'{rid} workload exited; replacing.')
+                self.terminate_replica(rid)
+                serve_state.set_replica_status(self.service_name, rid,
+                                               ReplicaStatus.FAILED)
+                continue
+            ok = rec['url'] is not None and self._probe_url(rec['url'])
+            if ok:
+                self._probe_failures[rid] = 0
+                if status is not ReplicaStatus.READY:
+                    serve_state.set_replica_status(
+                        self.service_name, rid, ReplicaStatus.READY)
+                    logger.info(f'Service {self.service_name!r}: replica '
+                                f'{rid} READY')
+                continue
+            failures = self._probe_failures.get(rid, 0) + 1
+            self._probe_failures[rid] = failures
+            if status is ReplicaStatus.STARTING:
+                if now - rec['launched_at'] > \
+                        self.spec.readiness_probe.initial_delay_seconds:
+                    logger.warning(
+                        f'Service {self.service_name!r}: replica {rid} '
+                        f'never became ready within initial delay; '
+                        f'replacing.')
+                    self.terminate_replica(rid)
+                    serve_state.set_replica_status(
+                        self.service_name, rid, ReplicaStatus.FAILED)
+                continue
+            if failures >= _REPLACE_THRESHOLD:
+                logger.warning(f'Service {self.service_name!r}: replica '
+                               f'{rid} failed {failures} probes; '
+                               f'replacing.')
+                self.terminate_replica(rid)
+                serve_state.set_replica_status(self.service_name, rid,
+                                               ReplicaStatus.FAILED)
+            elif failures >= _NOT_READY_THRESHOLD and \
+                    status is ReplicaStatus.READY:
+                serve_state.set_replica_status(self.service_name, rid,
+                                               ReplicaStatus.NOT_READY)
+
+    def _job_failed(self, rec: dict) -> bool:
+        record = global_user_state.get_cluster(rec['cluster_name'])
+        if record is None:
+            return False
+        client = self.backend._agent_client(record['handle'])  # pylint: disable=protected-access
+        try:
+            job = client.get_job(rec['cluster_job_id'])
+        except Exception:  # pylint: disable=broad-except
+            return False  # transient agent hiccup; the probe decides
+        finally:
+            client.close()
+        if job is None:
+            return False
+        from skypilot_tpu.agent.job_queue import JobStatus
+        return JobStatus(job['status']).is_terminal()
+
+    # ----- views --------------------------------------------------------------
+    def ready_urls(self) -> List[str]:
+        return [
+            r['url'] for r in serve_state.get_replicas(self.service_name)
+            if r['status'] is ReplicaStatus.READY and r['url']
+        ]
+
+    def num_live(self) -> int:
+        return sum(
+            1 for r in serve_state.get_replicas(self.service_name)
+            if r['status'].counts_toward_target())

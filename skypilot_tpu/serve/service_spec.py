@@ -1,0 +1,135 @@
+"""Service spec: the `service:` section of a task YAML
+(capability parity: sky/serve/service_spec.py).
+
+Parsed once at `serve up` and persisted with the service record so the
+controller can be re-adopted after an API-server restart.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils import schemas
+
+
+@dataclasses.dataclass(frozen=True)
+class ReadinessProbe:
+    path: str = '/'
+    initial_delay_seconds: float = 60.0
+    timeout_seconds: float = 5.0
+    # When set, the probe is a POST with this JSON body (the reference's
+    # post_data probe for completion endpoints).
+    post_data: Optional[Any] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class ServiceSpec:
+    """Validated, immutable service configuration."""
+    readiness_probe: ReadinessProbe
+    min_replicas: int = 1
+    max_replicas: Optional[int] = None       # None: fixed at min_replicas
+    target_qps_per_replica: Optional[float] = None
+    upscale_delay_seconds: float = 300.0
+    downscale_delay_seconds: float = 1200.0
+    load_balancing_policy: str = 'least_load'
+    # Spot-replica policy (reference: autoscalers.py dynamic fallback).
+    dynamic_ondemand_fallback: bool = False
+    base_ondemand_fallback_replicas: int = 0
+
+    @classmethod
+    def from_yaml_config(cls, config: Dict[str, Any]) -> 'ServiceSpec':
+        schemas.validate_service_config(config)
+        probe_raw = config['readiness_probe']
+        if isinstance(probe_raw, str):
+            probe = ReadinessProbe(path=probe_raw)
+        else:
+            probe = ReadinessProbe(
+                path=probe_raw['path'],
+                initial_delay_seconds=float(
+                    probe_raw.get('initial_delay_seconds', 60.0)),
+                timeout_seconds=float(
+                    probe_raw.get('timeout_seconds', 5.0)),
+                post_data=probe_raw.get('post_data'))
+        policy = config.get('replica_policy')
+        fixed = config.get('replicas')
+        if policy is not None and fixed is not None:
+            raise exceptions.InvalidTaskError(
+                'service: give either `replicas` (fixed) or '
+                '`replica_policy` (autoscaling), not both')
+        if policy is None:
+            n = int(fixed if fixed is not None else 1)
+            return cls(readiness_probe=probe, min_replicas=n,
+                       max_replicas=None, target_qps_per_replica=None,
+                       load_balancing_policy=config.get(
+                           'load_balancing_policy', 'least_load'))
+        min_r = int(policy.get('min_replicas', 1))
+        max_r = policy.get('max_replicas')
+        target_qps = policy.get('target_qps_per_replica')
+        if target_qps is not None and max_r is None:
+            raise exceptions.InvalidTaskError(
+                'service.replica_policy: target_qps_per_replica requires '
+                'max_replicas')
+        if max_r is not None and target_qps is None:
+            raise exceptions.InvalidTaskError(
+                'service.replica_policy: max_replicas without '
+                'target_qps_per_replica — autoscaling needs a QPS target '
+                '(or drop max_replicas for a fixed-size service)')
+        if max_r is not None and int(max_r) < min_r:
+            raise exceptions.InvalidTaskError(
+                f'service.replica_policy: max_replicas ({max_r}) < '
+                f'min_replicas ({min_r})')
+        return cls(
+            readiness_probe=probe,
+            min_replicas=min_r,
+            max_replicas=int(max_r) if max_r is not None else None,
+            target_qps_per_replica=(float(target_qps)
+                                    if target_qps is not None else None),
+            upscale_delay_seconds=float(
+                policy.get('upscale_delay_seconds', 300.0)),
+            downscale_delay_seconds=float(
+                policy.get('downscale_delay_seconds', 1200.0)),
+            load_balancing_policy=config.get('load_balancing_policy',
+                                             'least_load'),
+            dynamic_ondemand_fallback=bool(
+                policy.get('dynamic_ondemand_fallback', False)),
+            base_ondemand_fallback_replicas=int(
+                policy.get('base_ondemand_fallback_replicas', 0)),
+        )
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        probe: Dict[str, Any] = {'path': self.readiness_probe.path}
+        if self.readiness_probe.initial_delay_seconds != 60.0:
+            probe['initial_delay_seconds'] = \
+                self.readiness_probe.initial_delay_seconds
+        if self.readiness_probe.timeout_seconds != 5.0:
+            probe['timeout_seconds'] = self.readiness_probe.timeout_seconds
+        if self.readiness_probe.post_data is not None:
+            probe['post_data'] = self.readiness_probe.post_data
+        out: Dict[str, Any] = {'readiness_probe': probe}
+        if self.autoscaling_enabled:
+            policy: Dict[str, Any] = {
+                'min_replicas': self.min_replicas,
+                'max_replicas': self.max_replicas,
+            }
+            if self.target_qps_per_replica is not None:
+                policy['target_qps_per_replica'] = \
+                    self.target_qps_per_replica
+            policy['upscale_delay_seconds'] = self.upscale_delay_seconds
+            policy['downscale_delay_seconds'] = \
+                self.downscale_delay_seconds
+            if self.dynamic_ondemand_fallback:
+                policy['dynamic_ondemand_fallback'] = True
+            if self.base_ondemand_fallback_replicas:
+                policy['base_ondemand_fallback_replicas'] = \
+                    self.base_ondemand_fallback_replicas
+            out['replica_policy'] = policy
+        else:
+            out['replicas'] = self.min_replicas
+        out['load_balancing_policy'] = self.load_balancing_policy
+        return out
+
+    @property
+    def autoscaling_enabled(self) -> bool:
+        return self.max_replicas is not None and \
+            self.target_qps_per_replica is not None

@@ -43,11 +43,15 @@ def make_app() -> web.Application:
     app.on_cleanup.append(on_cleanup)
 
     async def on_startup(app):
-        # Re-adopt managed jobs orphaned by a server restart: their
-        # controller threads live in this process (consolidation mode).
+        # Re-adopt managed jobs and services orphaned by a server
+        # restart: their controller threads live in this process
+        # (consolidation mode).
         from skypilot_tpu.jobs import controller as jobs_controller
+        from skypilot_tpu.serve import controller as serve_controller
         await asyncio.get_event_loop().run_in_executor(
             None, jobs_controller.maybe_start_controllers)
+        await asyncio.get_event_loop().run_in_executor(
+            None, serve_controller.maybe_start_controllers)
 
     app.on_startup.append(on_startup)
 
@@ -253,6 +257,70 @@ def make_app() -> web.Application:
         return await _stream_cluster_job_logs(
             request, rec['cluster_name'], rec['cluster_job_id'], follow)
 
+    # ----- serve (controllers run consolidated in this process) --------------
+    async def serve_up(request):
+        body = await request.json()
+        task = task_lib.Task.from_yaml_config(body['task'])
+        name = body.get('name')
+
+        def work():
+            from skypilot_tpu import serve as serve_lib
+            return serve_lib.up(task, name)
+
+        request_id = request.app['executor'].submit(
+            'serve_up', body, work, long=False)
+        return web.json_response({'request_id': request_id})
+
+    async def serve_down(request):
+        body = await request.json()
+        name = body['name']
+        purge = bool(body.get('purge', False))
+
+        def work():
+            from skypilot_tpu import serve as serve_lib
+            serve_lib.down(name, purge=purge)
+            return {'down': name}
+
+        request_id = request.app['executor'].submit(
+            'serve_down', body, work, long=False)
+        return web.json_response({'request_id': request_id})
+
+    async def serve_status(request):
+        from skypilot_tpu import serve as serve_lib
+        names = request.query.getall('name', []) or None
+        records = await asyncio.get_event_loop().run_in_executor(
+            None, lambda: serve_lib.status(names))
+        out = []
+        for r in records:
+            r = dict(r)
+            r['status'] = r['status'].value
+            r['replicas'] = [
+                dict(rep, status=rep['status'].value)
+                for rep in r['replicas']
+            ]
+            out.append(r)
+        return web.json_response(out, dumps=lambda o: json.dumps(
+            o, default=str))
+
+    async def serve_replica_logs(request):
+        from skypilot_tpu.serve import serve_state as serve_state_lib
+        service = request.match_info['service']
+        replica_id = int(request.match_info['replica_id'])
+        follow = request.query.get('follow', '0') == '1'
+        rec = serve_state_lib.get_replica(service, replica_id)
+        if rec is None or rec['cluster_job_id'] is None:
+            return web.json_response({'error': 'replica logs unavailable'},
+                                     status=404)
+        from skypilot_tpu import exceptions as exc
+        try:
+            return await _stream_cluster_job_logs(
+                request, rec['cluster_name'], rec['cluster_job_id'],
+                follow)
+        except exc.ClusterDoesNotExistError:
+            # Replica already torn down (scaled down / preempted).
+            return web.json_response({'error': 'replica logs unavailable'},
+                                     status=404)
+
     async def cost_report(request):
         report = await asyncio.get_event_loop().run_in_executor(
             None, core.cost_report)
@@ -293,6 +361,11 @@ def make_app() -> web.Application:
     app.router.add_get('/jobs/queue', jobs_queue)
     app.router.add_post('/jobs/cancel', jobs_cancel)
     app.router.add_get('/jobs/logs/{job_id}', jobs_logs)
+    app.router.add_post('/serve/up', serve_up)
+    app.router.add_post('/serve/down', serve_down)
+    app.router.add_get('/serve/status', serve_status)
+    app.router.add_get('/serve/logs/{service}/{replica_id}',
+                       serve_replica_logs)
     app.router.add_get('/cost_report', cost_report)
     app.router.add_get('/accelerators', accelerators)
     app.router.add_get('/check', check)

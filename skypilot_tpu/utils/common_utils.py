@@ -55,6 +55,14 @@ def get_user_name() -> str:
         return 'unknown'
 
 
+def find_free_port() -> int:
+    """An OS-assigned free TCP port (racy by nature; callers bind fast)."""
+    import socket
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        return s.getsockname()[1]
+
+
 def generate_id(prefix: str = '', length: int = 8) -> str:
     suffix = uuid.uuid4().hex[:length]
     return f'{prefix}{suffix}' if prefix else suffix

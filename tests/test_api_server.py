@@ -166,3 +166,64 @@ def test_managed_jobs_over_rest(api_server, monkeypatch):
     assert 'managed-rest' in out.getvalue()
     # cancel of a finished job is a clean no-op over REST too
     assert sdk.jobs_cancel(job_id) is False
+
+
+def test_serve_over_rest(api_server, monkeypatch):
+    """serve up -> READY behind the LB -> proxied request -> down, all
+    via REST + CLI (controller + LB run inside the API-server process)."""
+    monkeypatch.setenv('SKYTPU_SERVE_TICK_INTERVAL', '0.25')
+    import urllib.request
+
+    from click.testing import CliRunner
+    from skypilot_tpu.client import sdk
+    from skypilot_tpu.client.cli import cli
+
+    run_cmd = ('python3 -c "import http.server, os\n'
+               'class H(http.server.BaseHTTPRequestHandler):\n'
+               '    def do_GET(self):\n'
+               '        self.send_response(200)\n'
+               '        self.send_header(\'Content-Length\', \'2\')\n'
+               '        self.end_headers()\n'
+               '        self.wfile.write(b\'ok\')\n'
+               '    def log_message(self, *a): pass\n'
+               'http.server.HTTPServer((\'127.0.0.1\', '
+               'int(os.environ[\'SKYTPU_SERVE_REPLICA_PORT\'])), '
+               'H).serve_forever()"')
+    task = _mk_local_task(run_cmd)
+    task.service = {'readiness_probe': {'path': '/',
+                                        'initial_delay_seconds': 30,
+                                        'timeout_seconds': 2},
+                    'replicas': 1}
+    result = sdk.get(sdk.serve_up(task, 'restsvc'))
+    assert result['name'] == 'restsvc'
+    endpoint = result['endpoint']
+    deadline = time.time() + 60
+    status = None
+    while time.time() < deadline:
+        svcs = sdk.serve_status(['restsvc'])
+        assert svcs, 'service missing from status'
+        status = svcs[0]['status']
+        if status in ('READY', 'FAILED', 'SHUTDOWN'):
+            break
+        time.sleep(0.3)
+    assert status == 'READY', status
+    with urllib.request.urlopen(endpoint + '/x', timeout=5) as resp:
+        assert resp.status == 200
+        assert resp.read() == b'ok'
+    # CLI status renders the replica table.
+    runner = CliRunner()
+    out = runner.invoke(cli, ['serve', 'status'])
+    assert out.exit_code == 0, out.output
+    assert 'restsvc' in out.output and 'READY' in out.output
+    # replica logs over REST
+    import io
+    buf = io.StringIO()
+    sdk.serve_replica_logs('restsvc', 1, follow=False, out=buf)
+    sdk.get(sdk.serve_down('restsvc'))
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        svcs = sdk.serve_status(['restsvc'])
+        if svcs and svcs[0]['status'] == 'SHUTDOWN':
+            break
+        time.sleep(0.3)
+    assert sdk.serve_status(['restsvc'])[0]['status'] == 'SHUTDOWN'

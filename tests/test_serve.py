@@ -1,0 +1,193 @@
+"""Serve e2e on the local cloud: up -> replicas READY behind the LB,
+load-driven autoscale, replica preemption -> replacement, down cleans up
+(the hermetic analog of the reference's tests/smoke_tests/test_sky_serve.py).
+"""
+import collections
+import time
+import urllib.request
+
+import pytest
+
+from skypilot_tpu import global_user_state
+from skypilot_tpu import serve
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.serve import controller as controller_lib
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.serve_state import ReplicaStatus, ServiceStatus
+from skypilot_tpu.task import Task
+
+# A tiny HTTP server that answers every GET with its replica id; binds the
+# port the replica manager injects.
+_SERVER_RUN = '''python3 -c "
+import http.server, os
+rid = os.environ['SKYTPU_SERVE_REPLICA_ID']
+class H(http.server.BaseHTTPRequestHandler):
+    def do_GET(self):
+        body = ('replica-' + rid).encode()
+        self.send_response(200)
+        self.send_header('Content-Length', str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+    def log_message(self, *a):
+        pass
+srv = http.server.ThreadingHTTPServer(
+    ('127.0.0.1', int(os.environ['SKYTPU_SERVE_REPLICA_PORT'])), H)
+srv.serve_forever()
+"'''
+
+
+@pytest.fixture
+def serve_env(tmp_home, enable_all_clouds, monkeypatch):
+    monkeypatch.setenv('SKYTPU_SERVE_TICK_INTERVAL', '0.25')
+    monkeypatch.setenv('SKYTPU_SERVE_QPS_WINDOW', '2')
+    return tmp_home
+
+
+def _service_task(name, service):
+    t = Task(name, run=_SERVER_RUN, service=service)
+    t.set_resources(Resources.from_yaml_config({'infra': 'local'}))
+    return t
+
+
+def _get(url, timeout=5.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.read().decode()
+
+
+def _wait_ready_replicas(name, n, timeout=60.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        ready = [r for r in serve_state.get_replicas(name)
+                 if r['status'] is ReplicaStatus.READY]
+        if len(ready) >= n:
+            return ready
+        time.sleep(0.2)
+    raise TimeoutError(
+        f'{name}: never reached {n} READY replicas; at '
+        f'{[(r["replica_id"], r["status"]) for r in serve_state.get_replicas(name)]}')
+
+
+def test_serve_up_load_balances_and_down(serve_env):
+    task = _service_task('echo-svc', {
+        'readiness_probe': {'path': '/', 'initial_delay_seconds': 30,
+                            'timeout_seconds': 2},
+        'replicas': 2,
+        'load_balancing_policy': 'round_robin',
+    })
+    result = serve.up(task)
+    endpoint = result['endpoint']
+    try:
+        controller_lib.wait_service_status(
+            'echo-svc', (ServiceStatus.READY,), timeout_s=60)
+        _wait_ready_replicas('echo-svc', 2)
+        # Round-robin across both replicas through the proxy.
+        seen = collections.Counter()
+        for _ in range(8):
+            code, body = _get(endpoint + '/anything')
+            assert code == 200
+            seen[body] += 1
+        assert len(seen) == 2, f'LB did not spread: {seen}'
+    finally:
+        serve.down('echo-svc')
+    controller_lib.wait_service_status(
+        'echo-svc', (ServiceStatus.SHUTDOWN,), timeout_s=60)
+    # Every replica cluster torn down.
+    for rec in serve_state.get_replicas('echo-svc', include_terminal=True):
+        assert global_user_state.get_cluster(rec['cluster_name']) is None
+    assert serve.status('echo-svc')[0]['status'] is ServiceStatus.SHUTDOWN
+
+
+def test_serve_replica_preemption_replaced(serve_env):
+    task = _service_task('prod-svc', {
+        'readiness_probe': {'path': '/', 'initial_delay_seconds': 30,
+                            'timeout_seconds': 2},
+        'replicas': 2,
+    })
+    serve.up(task)
+    try:
+        ready = _wait_ready_replicas('prod-svc', 2)
+        victim = ready[0]
+        from skypilot_tpu.provision.local import instance as local_instance
+        local_instance.inject_preemption(victim['cluster_name'])
+        # The victim is detected, terminated, and a fresh replica takes
+        # its place (new replica_id).
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            rec = serve_state.get_replica('prod-svc',
+                                          victim['replica_id'])
+            if rec['status'] is ReplicaStatus.PREEMPTED:
+                break
+            time.sleep(0.2)
+        else:
+            raise TimeoutError('preempted replica never marked PREEMPTED')
+        replacements = _wait_ready_replicas('prod-svc', 2)
+        new_ids = {r['replica_id'] for r in replacements}
+        assert victim['replica_id'] not in new_ids
+        assert max(new_ids) > victim['replica_id']
+    finally:
+        serve.down('prod-svc')
+    controller_lib.wait_service_status(
+        'prod-svc', (ServiceStatus.SHUTDOWN,), timeout_s=60)
+
+
+def test_serve_autoscales_under_load(serve_env):
+    task = _service_task('scale-svc', {
+        'readiness_probe': {'path': '/', 'initial_delay_seconds': 30,
+                            'timeout_seconds': 2},
+        'replica_policy': {
+            'min_replicas': 1,
+            'max_replicas': 3,
+            'target_qps_per_replica': 2.0,
+            'upscale_delay_seconds': 0.5,
+            'downscale_delay_seconds': 600,
+        },
+    })
+    result = serve.up(task)
+    endpoint = result['endpoint']
+    try:
+        _wait_ready_replicas('scale-svc', 1)
+        # Sustained ~12 qps against target 2/replica -> desired hits the
+        # max_replicas=3 clamp once hysteresis elapses.
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            for _ in range(3):
+                try:
+                    _get(endpoint + '/load')
+                except OSError:
+                    pass
+            live = serve_state.get_replicas('scale-svc')
+            if len([r for r in live
+                    if r['status'].counts_toward_target()]) >= 3:
+                break
+            time.sleep(0.25)
+        else:
+            raise TimeoutError(
+                f'never scaled to 3; at '
+                f'{[(r["replica_id"], r["status"]) for r in serve_state.get_replicas("scale-svc")]}')
+        _wait_ready_replicas('scale-svc', 3)
+    finally:
+        serve.down('scale-svc')
+    controller_lib.wait_service_status(
+        'scale-svc', (ServiceStatus.SHUTDOWN,), timeout_s=60)
+
+
+def test_serve_duplicate_name_rejected(serve_env):
+    task = _service_task('dup-svc', {'readiness_probe': '/',
+                                     'replicas': 1})
+    serve.up(task)
+    try:
+        from skypilot_tpu import exceptions
+        with pytest.raises(exceptions.ServeError):
+            serve.up(task)
+    finally:
+        serve.down('dup-svc')
+    controller_lib.wait_service_status(
+        'dup-svc', (ServiceStatus.SHUTDOWN,), timeout_s=60)
+
+
+def test_serve_requires_service_section(serve_env):
+    from skypilot_tpu import exceptions
+    t = Task('nosvc', run='echo hi')
+    t.set_resources(Resources.from_yaml_config({'infra': 'local'}))
+    with pytest.raises(exceptions.InvalidTaskError):
+        serve.up(t)

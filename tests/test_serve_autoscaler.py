@@ -1,0 +1,228 @@
+"""Autoscaler + LB-policy + spot-placer unit tests over synthetic traces
+(analog of the reference's tests/test_serve_autoscaler.py simulation)."""
+import pytest
+
+from skypilot_tpu.serve.autoscalers import (Autoscaler,
+                                            RequestRateAutoscaler)
+from skypilot_tpu.serve.load_balancing_policies import (
+    LeastLoadPolicy, LoadBalancingPolicy, RoundRobinPolicy)
+from skypilot_tpu.serve.service_spec import ServiceSpec
+from skypilot_tpu.serve.spot_placer import SpotPlacer
+
+
+def _spec(**policy):
+    return ServiceSpec.from_yaml_config({
+        'readiness_probe': '/health',
+        'replica_policy': {
+            'min_replicas': 1,
+            'max_replicas': 5,
+            'target_qps_per_replica': 2.0,
+            'upscale_delay_seconds': 2.0,
+            'downscale_delay_seconds': 4.0,
+            **policy,
+        },
+    })
+
+
+def _trace(qps, now, window):
+    """`qps` requests/second uniformly over the last `window` seconds."""
+    n = int(qps * window)
+    return [now - window * i / max(n, 1) for i in range(n)]
+
+
+def test_fixed_autoscaler_holds_replica_count():
+    spec = ServiceSpec.from_yaml_config(
+        {'readiness_probe': '/', 'replicas': 3})
+    a = Autoscaler.make(spec, decision_interval_seconds=1.0)
+    assert not isinstance(a, RequestRateAutoscaler)
+    d = a.evaluate([], 0)
+    assert d.target_num_replicas == 3 and d.delta == 3
+    assert a.evaluate([], 3).delta == 0
+    # Fixed policy ignores load entirely.
+    assert a.evaluate([0.0] * 1000, 3).delta == 0
+
+
+def test_request_rate_autoscaler_upscale_hysteresis():
+    # interval 1s, upscale_delay 2s -> 2 consecutive ticks needed.
+    a = RequestRateAutoscaler(_spec(), decision_interval_seconds=1.0,
+                              qps_window_seconds=10.0)
+    now = 1000.0
+    # 6 qps / 2 qps-per-replica = 3 replicas desired; first tick: hold.
+    trace = _trace(6.0, now, 10.0)
+    assert a.evaluate(trace, 1, now).target_num_replicas == 1
+    # Second consecutive overloaded tick: commit the upscale.
+    d = a.evaluate(trace, 1, now + 1)
+    assert d.target_num_replicas == 3
+    assert d.delta == 2
+
+
+def test_request_rate_autoscaler_transient_spike_ignored():
+    a = RequestRateAutoscaler(_spec(), decision_interval_seconds=1.0,
+                              qps_window_seconds=10.0)
+    now = 1000.0
+    assert a.evaluate(_trace(6.0, now, 10.0), 1,
+                      now).target_num_replicas == 1
+    # Load vanished before the delay elapsed: counter resets, no upscale.
+    assert a.evaluate([], 1, now + 1).target_num_replicas == 1
+    assert a.evaluate(_trace(6.0, now + 2, 10.0), 1,
+                      now + 2).target_num_replicas == 1
+
+
+def test_request_rate_autoscaler_downscale_slower_than_upscale():
+    a = RequestRateAutoscaler(_spec(), decision_interval_seconds=1.0,
+                              qps_window_seconds=10.0)
+    now = 1000.0
+    trace = _trace(8.0, now, 10.0)
+    a.evaluate(trace, 1, now)
+    assert a.evaluate(trace, 1, now + 1).target_num_replicas == 4
+    # Load disappears: downscale only after 4 consecutive idle ticks.
+    for i in range(3):
+        assert a.evaluate([], 4, now + 2 + i).target_num_replicas == 4
+    d = a.evaluate([], 4, now + 5)
+    assert d.target_num_replicas == 1
+    assert d.delta == -3
+
+
+def test_request_rate_autoscaler_clamps_to_bounds():
+    a = RequestRateAutoscaler(_spec(), decision_interval_seconds=1.0,
+                              qps_window_seconds=10.0)
+    now = 1000.0
+    flood = _trace(100.0, now, 10.0)
+    a.evaluate(flood, 1, now)
+    assert a.evaluate(flood, 1, now + 1).target_num_replicas == 5  # max
+    quiet = []
+    for i in range(4):
+        a.evaluate(quiet, 5, now + 2 + i)
+    assert a.target_num_replicas == 1  # min
+
+
+def test_round_robin_policy_cycles():
+    p = RoundRobinPolicy()
+    urls = ['a', 'b', 'c']
+    assert [p.select(urls) for _ in range(6)] == ['a', 'b', 'c'] * 2
+    assert p.select([]) is None
+
+
+def test_least_load_policy_tracks_outstanding():
+    p = LeastLoadPolicy()
+    urls = ['a', 'b']
+    u1 = p.select(urls)
+    p.on_request_start(u1)
+    u2 = p.select(urls)
+    assert u2 != u1  # the busy one is avoided
+    p.on_request_start(u2)
+    p.on_request_end(u1)
+    assert p.select(urls) == u1
+    assert p.select([]) is None
+
+
+def test_policy_registry():
+    assert isinstance(LoadBalancingPolicy.make('round_robin'),
+                      RoundRobinPolicy)
+    assert isinstance(LoadBalancingPolicy.make('least_load'),
+                      LeastLoadPolicy)
+    with pytest.raises(ValueError):
+        LoadBalancingPolicy.make('nope')
+
+
+def test_spot_placer_spreads_and_avoids_preempted():
+    p = SpotPlacer(['z-a', 'z-b', 'z-c'])
+    picks = [p.select() for _ in range(3)]
+    assert sorted(picks) == ['z-a', 'z-b', 'z-c']  # spread before reuse
+    p.handle_preemption('z-b')
+    assert 'z-b' in p.preempted_zones()
+    assert all(p.select() != 'z-b' for _ in range(4))
+
+
+def test_spot_placer_resets_when_all_preempted():
+    p = SpotPlacer(['z-a', 'z-b'])
+    p.handle_preemption('z-a')
+    p.handle_preemption('z-b')
+    # Everything preempted: pool resets rather than refusing placement.
+    assert p.select() in ('z-a', 'z-b')
+
+
+def test_spot_placer_no_zones():
+    assert SpotPlacer([]).select() is None
+
+
+def test_service_spec_validation():
+    spec = ServiceSpec.from_yaml_config({
+        'readiness_probe': {'path': '/h', 'initial_delay_seconds': 5},
+        'replica_policy': {'min_replicas': 1, 'max_replicas': 3,
+                           'target_qps_per_replica': 1.5},
+    })
+    assert spec.autoscaling_enabled
+    assert spec.readiness_probe.path == '/h'
+    # round-trips
+    again = ServiceSpec.from_yaml_config(spec.to_yaml_config())
+    assert again == spec
+
+    fixed = ServiceSpec.from_yaml_config(
+        {'readiness_probe': '/x', 'replicas': 2})
+    assert not fixed.autoscaling_enabled
+    assert fixed.min_replicas == 2
+    assert ServiceSpec.from_yaml_config(
+        fixed.to_yaml_config()) == fixed
+
+    from skypilot_tpu import exceptions
+    with pytest.raises(exceptions.InvalidTaskError):
+        ServiceSpec.from_yaml_config({
+            'readiness_probe': '/',
+            'replicas': 2,
+            'replica_policy': {'min_replicas': 1},
+        })
+    with pytest.raises(exceptions.InvalidTaskError):
+        ServiceSpec.from_yaml_config({
+            'readiness_probe': '/',
+            'replica_policy': {'min_replicas': 3, 'max_replicas': 1},
+        })
+
+
+def test_spec_rejects_max_without_qps_target():
+    from skypilot_tpu import exceptions
+    with pytest.raises(exceptions.InvalidTaskError):
+        ServiceSpec.from_yaml_config({
+            'readiness_probe': '/',
+            'replica_policy': {'min_replicas': 1, 'max_replicas': 5},
+        })
+
+
+def test_ondemand_fallback_selection(tmp_home):
+    """base_ondemand_fallback_replicas pins the first N replicas to
+    on-demand; dynamic fallback bridges on on-demand when every zone has
+    preempted us."""
+    from skypilot_tpu.resources import Resources
+    from skypilot_tpu.serve import serve_state
+    from skypilot_tpu.serve.replica_managers import ReplicaManager
+    from skypilot_tpu.task import Task
+
+    spec = ServiceSpec.from_yaml_config({
+        'readiness_probe': '/',
+        'replica_policy': {
+            'min_replicas': 2, 'max_replicas': 4,
+            'target_qps_per_replica': 1.0,
+            'base_ondemand_fallback_replicas': 1,
+            'dynamic_ondemand_fallback': True,
+        },
+    })
+    t = Task('spotsvc', run='true')
+    t.set_resources(Resources.from_yaml_config(
+        {'infra': 'gcp', 'accelerators': 'tpu-v5p-8', 'use_spot': True}))
+    placer = SpotPlacer(['us-east5-a', 'us-east5-b'])
+    mgr = ReplicaManager('spotsvc', spec, t, spot_placer=placer)
+
+    serve_state.add_service('spotsvc', spec.to_yaml_config(),
+                            t.to_yaml_config(), 12345)
+    # First replica: on-demand (base fallback not yet covered).
+    assert mgr._next_is_spot() is False
+    serve_state.add_replica('spotsvc', 1, 'serve-spotsvc-1',
+                            is_spot=False)
+    # Base covered -> next is spot.
+    assert mgr._next_is_spot() is True
+    serve_state.add_replica('spotsvc', 2, 'serve-spotsvc-2',
+                            is_spot=True, zone='us-east5-a')
+    # Every zone preempts us -> dynamic fallback bridges on on-demand.
+    placer.handle_preemption('us-east5-a')
+    placer.handle_preemption('us-east5-b')
+    assert mgr._next_is_spot() is False
