@@ -3,9 +3,10 @@
 `mha_reference` is the XLA implementation (always correct, runs anywhere,
 fuses well).  `flash_attention` dispatches to the Pallas online-softmax
 kernel on TPU (`ops/pallas/flash_attention.py`) and falls back to the
-reference elsewhere.  Backward of the Pallas path recomputes attention via
-the XLA implementation (flash-style recompute: O(S) memory, trades FLOPs for
-HBM — the right trade on TPU where attention bwd is bandwidth-bound).
+reference elsewhere.  Backward of the Pallas path is the Pallas flash
+backward (chunked recompute from saved logsumexp: O(S) memory, trades
+FLOPs for HBM — the right trade on TPU where attention bwd is
+bandwidth-bound; nothing O(S^2) is ever materialized in HBM).
 
 Shapes: q [B, Hq, Sq, D], k/v [B, Hkv, Sk, D]; grouped-query attention is
 expressed by Hq = G * Hkv (query heads grouped over kv heads).
@@ -88,19 +89,29 @@ def _flash_fwd_impl(q, k, v, causal, block_size):
 
 
 def _flash_fwd(q, k, v, causal, block_size):
-    out = _flash_fwd_impl(q, k, v, causal, block_size)
-    return out, (q, k, v)
+    if jax.default_backend() == 'tpu':
+        from skypilot_tpu.ops.pallas import flash_attention as pallas_fa
+        out, lse = pallas_fa.flash_attention_fwd(
+            q, k, v, causal=causal, block_size=block_size,
+            return_residuals=True)
+        return out, (q, k, v, out, lse)
+    out = mha_reference(q, k, v, causal=causal)
+    return out, (q, k, v, None, None)
 
 
 def _flash_bwd(causal, block_size, residuals, g):
-    del block_size
-    q, k, v = residuals
-    # Flash-style recompute: re-run the XLA forward under vjp.  XLA fuses
-    # this into a bandwidth-friendly bwd; no O(S^2) tensor is materialized
-    # in HBM beyond the recompute tiles.
-    _, vjp_fn = jax.vjp(
-        lambda q_, k_, v_: mha_reference(q_, k_, v_, causal=causal), q, k, v)
-    return vjp_fn(g)
+    q, k, v, out, lse = residuals
+    if out is None:
+        # XLA path (non-TPU): recompute under vjp; XLA fuses this into a
+        # bandwidth-friendly bwd.
+        _, vjp_fn = jax.vjp(
+            lambda q_, k_, v_: mha_reference(q_, k_, v_, causal=causal),
+            q, k, v)
+        return vjp_fn(g)
+    from skypilot_tpu.ops.pallas import flash_attention as pallas_fa
+    # flash_attention_bwd returns dk/dv already group-reduced to Hkv heads.
+    return pallas_fa.flash_attention_bwd(
+        q, k, v, out, lse, g, causal=causal, block_size=block_size)
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)

@@ -1,14 +1,26 @@
-"""Pallas TPU flash-attention forward kernel (online softmax).
+"""Pallas TPU flash-attention kernels (forward + backward).
 
-Canonical TPU pattern: 3D grid (batch*heads, q_blocks, k_blocks) with the
-k dimension innermost — Mosaic iterates the last grid axis sequentially on
-the core, so VMEM scratch (running max `m`, denominator `l`, accumulator
-`acc`) persists across k steps of one q block.  Causal blocks strictly above
-the diagonal are skipped with `pl.when` (no MXU work issued).
+Forward: canonical TPU pattern — 3D grid (batch*heads, q_blocks, k_blocks)
+with the k dimension innermost; Mosaic iterates the last grid axis
+sequentially on the core, so VMEM scratch (running max `m`, denominator
+`l`, accumulator `acc`) persists across k steps of one q block.  Causal
+blocks strictly above the diagonal are skipped with `pl.when` (no MXU work
+issued).  With `return_residuals=True` the kernel also emits the row
+logsumexp, stored lane-broadcast as (bh, S, 128) f32 (the TPU layout
+convention for per-row scalars) and compacted to (bh, S) outside.
+
+Backward: two kernels, both flash-style recompute from (q, k, v, lse,
+delta) so nothing O(S^2) ever lands in HBM:
+  - dq:    grid (bh, q_blocks, k_blocks), k innermost, dq accumulates in
+           VMEM scratch across the k sweep of one q block.
+  - dk/dv: grid (bh, k_blocks, q_blocks), q innermost, dk/dv accumulate
+           across the q sweep of one k block.
+`delta = rowsum(dO * O)` is the standard softmax-backward correction and is
+computed in XLA (O(S*D), fuses into the surrounding graph).
 
 Sizing: q/k/v blocks live in VMEM ((block, D) each); with block=512 and
 D=128 in bf16 that is ~128 KB per operand — far under the ~16 MB/core VMEM,
-leaving room for the f32 accumulator and double buffering.
+leaving room for the f32 accumulators and double buffering.
 """
 from __future__ import annotations
 
@@ -22,8 +34,14 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_INF = -1e30
 
 
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
-               scale: float, causal: bool, block_q: int, block_k: int):
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
+               scale: float, causal: bool, block_q: int, block_k: int,
+               with_lse: bool = False):
+    if with_lse:
+        lse_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        lse_ref = None
+        m_scr, l_scr, acc_scr = rest
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     n_k = pl.num_programs(2)
@@ -68,18 +86,27 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
         l = l_scr[:, :1]
         safe_l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
+        if lse_ref is not None:
+            # lse = m + log(l); +inf for all-masked rows so the backward's
+            # exp(s - lse) underflows to exactly 0 there.
+            lse = jnp.where(l == 0.0, jnp.inf, m_scr[:, :1] + jnp.log(safe_l))
+            lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
 
 @functools.partial(jax.jit,
-                   static_argnames=('causal', 'block_size', 'interpret'))
+                   static_argnames=('causal', 'block_size', 'interpret',
+                                    'return_residuals'))
 def flash_attention_fwd(q: jax.Array,
                         k: jax.Array,
                         v: jax.Array,
                         causal: bool = True,
                         block_size: int = 512,
-                        interpret: bool = False) -> jax.Array:
+                        interpret: bool = False,
+                        return_residuals: bool = False):
     """q [B,Hq,S,D], k/v [B,Hkv,S,D] → [B,Hq,S,D].  GQA via head repeat
-    (broadcast, fused by XLA before the kernel)."""
+    (broadcast, fused by XLA before the kernel).  With
+    `return_residuals=True` also returns the row logsumexp [B,Hq,S] f32
+    for the backward kernels."""
     b, hq, s, d = q.shape
     hkv = k.shape[1]
     if hkv != hq:
@@ -95,7 +122,19 @@ def flash_attention_fwd(q: jax.Array,
     v3 = v.reshape(b * hq, s, d)
     grid = (b * hq, s // block_q, s // block_k)
     kernel = functools.partial(_fa_kernel, scale=scale, causal=causal,
-                               block_q=block_q, block_k=block_k)
+                               block_q=block_q, block_k=block_k,
+                               with_lse=return_residuals)
+    out_specs = pl.BlockSpec((1, block_q, d), lambda bh, qi, kj: (bh, qi, 0))
+    out_shape = jax.ShapeDtypeStruct((b * hq, s, d), q.dtype)
+    if return_residuals:
+        out_specs = [
+            out_specs,
+            pl.BlockSpec((1, block_q, 128), lambda bh, qi, kj: (bh, qi, 0)),
+        ]
+        out_shape = [
+            out_shape,
+            jax.ShapeDtypeStruct((b * hq, s, 128), jnp.float32),
+        ]
     out = pl.pallas_call(
         kernel,
         grid=grid,
@@ -104,9 +143,8 @@ def flash_attention_fwd(q: jax.Array,
             pl.BlockSpec((1, block_k, d), lambda bh, qi, kj: (bh, kj, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, qi, kj: (bh, kj, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d),
-                               lambda bh, qi, kj: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * hq, s, d), q.dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),   # running max m
             pltpu.VMEM((block_q, 1), jnp.float32),     # denominator l
@@ -119,4 +157,200 @@ def flash_attention_fwd(q: jax.Array,
         ),
         interpret=interpret,
     )(q3, k3, v3)
+    if return_residuals:
+        o, lse = out
+        return o.reshape(b, hq, s, d), lse[:, :, 0].reshape(b, hq, s)
     return out.reshape(b, hq, s, d)
+
+
+# ----- backward ---------------------------------------------------------------
+
+
+def _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    qi, kj, *, scale, causal, block_q, block_k):
+    """Shared backward recompute: p = softmax tile from saved lse, and
+    ds = p * (dO·V^T - delta) * scale.  Both bwd kernels consume these;
+    keeping the mask/scale arithmetic in one place keeps dq consistent
+    with dk/dv by construction."""
+    q = q_ref[0]                                   # (bq, D)
+    k = k_ref[0]                                   # (bk, D)
+    v = v_ref[0]                                   # (bk, D)
+    do = do_ref[0]                                 # (bq, D)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale  # (bq, bk)
+    if causal:
+        q_pos = (qi * block_q +
+                 jax.lax.broadcasted_iota(jnp.int32, s.shape, 0))
+        k_pos = (kj * block_k +
+                 jax.lax.broadcasted_iota(jnp.int32, s.shape, 1))
+        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+    p = jnp.exp(s - lse_ref[0][:, :1])             # (bq, bk)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)        # (bq, bk)
+    ds = p * (dp - delta_ref[0][:, :1]) * scale    # (bq, bk)
+    return p, ds
+
+
+def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, dq_scr, *, scale: float, causal: bool,
+                      block_q: int, block_k: int):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    n_k = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    diag_ok = (not causal) or (kj * block_k <= qi * block_q + block_q - 1)
+
+    @pl.when(diag_ok)
+    def _compute():
+        _, ds = _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref,
+                                delta_ref, qi, kj, scale=scale,
+                                causal=causal, block_q=block_q,
+                                block_k=block_k)
+        k = k_ref[0]
+        dq_scr[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # (bq, D)
+
+    @pl.when(kj == n_k - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                       dk_ref, dv_ref, dk_scr, dv_scr, *, scale: float,
+                       causal: bool, block_q: int, block_k: int):
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+    n_q = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    # Causal: a q block strictly before the k block attends to none of it.
+    diag_ok = (not causal) or (qi * block_q + block_q - 1 >= kj * block_k)
+
+    @pl.when(diag_ok)
+    def _compute():
+        p, ds = _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref,
+                                delta_ref, qi, kj, scale=scale,
+                                causal=causal, block_q=block_q,
+                                block_k=block_k)
+        q = q_ref[0]
+        do = do_ref[0]
+        dv_scr[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # (bk, D)
+        dk_scr[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # (bk, D)
+
+    @pl.when(qi == n_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=('causal', 'block_size', 'interpret'))
+def flash_attention_bwd(q: jax.Array,
+                        k: jax.Array,
+                        v: jax.Array,
+                        out: jax.Array,
+                        lse: jax.Array,
+                        g: jax.Array,
+                        causal: bool = True,
+                        block_size: int = 512,
+                        interpret: bool = False):
+    """Flash backward.  q/out/g [B,Hq,S,D], k/v [B,Hkv,S,D],
+    lse [B,Hq,S] f32.  Returns (dq, dk, dv) with dk/dv at Hkv heads —
+    GQA grads are group-reduced here, mirroring the repeat this function
+    performs on the way in.
+    """
+    b, hq, s, d = q.shape
+    hkv = k.shape[1]
+    k_dtype, v_dtype = k.dtype, v.dtype
+    if hkv != hq:
+        k = jnp.repeat(k, hq // hkv, axis=1)
+        v = jnp.repeat(v, hq // hkv, axis=1)
+    scale = d**-0.5
+    block_q = min(block_size, s)
+    block_k = min(block_size, s)
+    if s % block_q or s % block_k:
+        raise ValueError(f'seq len {s} must divide block size {block_q}')
+    bh = b * hq
+    q3 = q.reshape(bh, s, d)
+    k3 = k.reshape(bh, s, d)
+    v3 = v.reshape(bh, s, d)
+    do3 = g.reshape(bh, s, d)
+    # delta = rowsum(dO * O): the softmax-backward correction term.  O(S*D)
+    # in XLA; lane-broadcast to the (bh, S, 128) scalar-row convention.
+    delta = jnp.sum(do3.astype(jnp.float32) *
+                    out.reshape(bh, s, d).astype(jnp.float32), axis=-1)
+    delta3 = jnp.broadcast_to(delta[:, :, None], (bh, s, 128))
+    lse3 = jnp.broadcast_to(lse.reshape(bh, s)[:, :, None], (bh, s, 128))
+
+    q_spec = pl.BlockSpec((1, block_q, d), lambda bh_, i, j: (bh_, i, 0))
+    row_spec = pl.BlockSpec((1, block_q, 128), lambda bh_, i, j: (bh_, i, 0))
+    flops = 5 * b * hq * s * s * d // (2 if causal else 1)
+    io_bytes = (q3.size * 4 + do3.size * 2) * q.dtype.itemsize
+
+    dq = pl.pallas_call(
+        functools.partial(_fa_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=(bh, s // block_q, s // block_k),
+        in_specs=[
+            q_spec,
+            pl.BlockSpec((1, block_k, d), lambda bh_, qi, kj: (bh_, kj, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh_, qi, kj: (bh_, kj, 0)),
+            q_spec,
+            row_spec,
+            row_spec,
+        ],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        cost_estimate=pl.CostEstimate(
+            flops=3 * flops // 5, bytes_accessed=io_bytes,
+            transcendentals=bh * s * s),
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse3, delta3)
+
+    # dk/dv sweep: q innermost so the (bk, D) accumulators persist.
+    kv_spec = pl.BlockSpec((1, block_k, d), lambda bh_, kj, qi: (bh_, kj, 0))
+    q_spec_t = pl.BlockSpec((1, block_q, d), lambda bh_, kj, qi: (bh_, qi, 0))
+    row_spec_t = pl.BlockSpec((1, block_q, 128),
+                              lambda bh_, kj, qi: (bh_, qi, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_fa_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=(bh, s // block_k, s // block_q),
+        in_specs=[q_spec_t, kv_spec, kv_spec, q_spec_t, row_spec_t,
+                  row_spec_t],
+        out_specs=[kv_spec, kv_spec],
+        out_shape=[jax.ShapeDtypeStruct((bh, s, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, s, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * flops // 5, bytes_accessed=io_bytes,
+            transcendentals=bh * s * s),
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse3, delta3)
+    dq = dq.reshape(b, hq, s, d)
+    dk = dk.reshape(b, hq, s, d)
+    dv = dv.reshape(b, hq, s, d)
+    if hkv != hq:
+        # jnp.repeat(axis=1) laid heads out [h0,h0,...,h1,h1,...]; the
+        # (hkv, group) reshape matches that layout exactly.
+        group = hq // hkv
+        dk = dk.reshape(b, hkv, group, s, d).sum(axis=2).astype(k_dtype)
+        dv = dv.reshape(b, hkv, group, s, d).sum(axis=2).astype(v_dtype)
+    return dq, dk, dv
