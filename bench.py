@@ -1,20 +1,30 @@
-"""Headline benchmark: Llama training MFU on one TPU chip.
+"""Headline benchmark: Llama training MFU + serving throughput on one chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} with the
+north-star metrics in "detail":
+  - train: MFU, tokens/sec/chip, $/1M-tokens (catalog price x throughput)
+  - serve: req/s, output tok/s, TTFT, TPOT from the continuous-batching
+    decode engine (skypilot_tpu/inference)
 
-Baseline: the reference's published Llama-3-8B run on TPU v6e-8
+Training baseline: the reference's published Llama-3-8B run on TPU v6e-8
 (PyTorch/XLA FSDP, examples/tpu/v6e/README.md:34-48): total_flos
 109935420 GF over train_runtime 672.77 s on 8 chips = 163.4 TFLOP/s
 = 20.4 TFLOP/s/chip = 2.22% MFU (v6e peak 918 bf16 TFLOP/s/chip).
-MFU is the hardware-neutral comparison: this bench trains a smaller Llama
-(single chip, 16 GB HBM) but measures the same quantity — model FLOPs
-utilization of the chip it runs on — so vs_baseline = our_MFU / 2.22%.
+MFU is the hardware-neutral comparison: this bench trains a ~1B Llama at
+seq 4096 (single chip, 16 GB HBM) but measures the same quantity — model
+FLOPs utilization of the chip it runs on — so vs_baseline = our MFU / 2.22%.
+
+Serving baseline: JetStream Llama-2-7B on v6e: 11.42 req/s, 2147.98
+output tok/s, median TPOT 18.88 ms (examples/tpu/v6e/README.md:119-127).
+Reported for context; model sizes differ, so serve numbers are not folded
+into vs_baseline.
 
 Sync note: on this environment's axon TPU platform, block_until_ready
 returns early; every timed section syncs via np.array() D2H copies.
 """
 from __future__ import annotations
 
+import dataclasses
 import json
 import time
 
@@ -34,27 +44,43 @@ PEAK_BF16_TFLOPS = {
 }
 
 
-def _chip_peak_tflops() -> float:
+def _chip_kind() -> str:
     dev = jax.devices()[0]
-    kind = getattr(dev, 'device_kind', 'cpu').lower()
-    for name, peak in PEAK_BF16_TFLOPS.items():
-        if name in kind.replace(' ', ''):
-            return peak
+    kind = getattr(dev, 'device_kind', 'cpu').lower().replace(' ', '')
+    for name in PEAK_BF16_TFLOPS:
+        if name in kind:
+            return name
     if 'lite' in kind:      # 'TPU v5 lite'
-        return PEAK_BF16_TFLOPS['v5e']
-    return PEAK_BF16_TFLOPS['cpu']
+        return 'v5litepod'
+    return 'cpu'
 
 
-def main() -> None:
-    from skypilot_tpu.models.llama import Llama, LLAMA_CONFIGS
+_CATALOG_GENERATION = {'v5e': 'v5litepod'}  # device-kind name != SKU name
+
+
+def _chip_price_per_hr(kind: str) -> tuple:
+    """(on-demand, spot) $/chip/hr from the bundled catalog."""
+    try:
+        from skypilot_tpu.catalog import gcp_catalog
+        df = gcp_catalog._tpu_df.read()  # pylint: disable=protected-access
+        rows = df[df['generation'] == _CATALOG_GENERATION.get(kind, kind)]
+        if len(rows):
+            return (float(rows['price_chip_hr'].min()),
+                    float(rows['spot_price_chip_hr'].min()))
+    except Exception:  # pylint: disable=broad-except
+        pass
+    return (0.0, 0.0)
+
+
+def bench_train(on_tpu: bool) -> dict:
+    from skypilot_tpu.models.llama import LLAMA_CONFIGS, Llama
     from skypilot_tpu.parallel.mesh import build_mesh, plan_mesh
     from skypilot_tpu.train.trainer import TrainConfig, Trainer
 
-    on_tpu = jax.default_backend() == 'tpu'
-    cfg = LLAMA_CONFIGS['bench-600m' if on_tpu else 'tiny']
-    seq = 2048 if on_tpu else 64
-    batch = 8 if on_tpu else 4
-    steps = 20 if on_tpu else 3
+    cfg = LLAMA_CONFIGS['bench-1b' if on_tpu else 'tiny']
+    seq = 4096 if on_tpu else 64
+    batch = 4
+    steps = 15 if on_tpu else 3
 
     mesh = build_mesh(plan_mesh(1), jax.devices()[:1])
     model = Llama(cfg, mesh)
@@ -63,7 +89,6 @@ def main() -> None:
     trainer = Trainer(model, mesh, rng, tokens,
                       TrainConfig(warmup_steps=5, total_steps=1000))
 
-    # Warmup (compile + first steps).
     state = trainer.state
     for _ in range(2):
         state, metrics = trainer.train_step(state, tokens)
@@ -75,29 +100,106 @@ def main() -> None:
     np.array(metrics['loss'])
     dt = (time.perf_counter() - t0) / steps
 
-    tokens_per_step = batch * seq
-    tokens_per_s = tokens_per_step / dt
+    tokens_per_s = batch * seq / dt
     n_params = cfg.num_params()
     # fwd+bwd model flops/token: 6N dense + causal attention term.
-    flops_per_token = (6 * n_params +
-                       6 * cfg.n_layers * seq * cfg.dim)
+    flops_per_token = 6 * n_params + 6 * cfg.n_layers * seq * cfg.dim
     model_tflops = tokens_per_s * flops_per_token / 1e12
-    peak = _chip_peak_tflops()
+    kind = _chip_kind()
+    peak = PEAK_BF16_TFLOPS[kind]
     mfu = 100.0 * model_tflops / peak
+    price, spot_price = _chip_price_per_hr(kind)
+    tok_per_hr = tokens_per_s * 3600.0
+    usd_per_1m = price / (tok_per_hr / 1e6) if tok_per_hr else 0.0
+    usd_per_1m_spot = spot_price / (tok_per_hr / 1e6) if tok_per_hr else 0.0
+    return {
+        'mfu_pct': round(mfu, 2),
+        'tokens_per_s_per_chip': round(tokens_per_s, 1),
+        'usd_per_1m_tokens': round(usd_per_1m, 4),
+        'usd_per_1m_tokens_spot': round(usd_per_1m_spot, 4),
+        'model_params_m': round(n_params / 1e6, 1),
+        'model_tflops_per_s': round(model_tflops, 2),
+        'chip': kind,
+        'chip_peak_tflops': peak,
+        'chip_price_hr': price,
+        'step_time_ms': round(dt * 1e3, 2),
+        'seq_len': seq,
+        'batch': batch,
+    }
 
+
+def bench_serve(on_tpu: bool) -> dict:
+    from skypilot_tpu.inference.engine import DecodeEngine, EngineConfig
+    from skypilot_tpu.models.llama import LLAMA_CONFIGS, Llama, init_params
+
+    cfg = dataclasses.replace(
+        LLAMA_CONFIGS['bench-600m' if on_tpu else 'tiny'],
+        max_seq_len=1024 if on_tpu else 128)
+    model = Llama(cfg)
+    params = init_params(model, jax.random.PRNGKey(0))['params']
+    # Inference is HBM-bandwidth-bound: serve bf16 weights (f32 masters
+    # are a training concern).
+    params = jax.tree_util.tree_map(
+        lambda x: x.astype(jnp.bfloat16) if x.dtype == jnp.float32 else x,
+        params)
+    n_slots = 16 if on_tpu else 2
+    prompt_len = 128 if on_tpu else 8
+    new_tokens = 64 if on_tpu else 4
+    n_requests = 48 if on_tpu else 4
+
+    engine = DecodeEngine(
+        model, params,
+        EngineConfig(n_slots=n_slots,
+                     steps_per_call=32 if on_tpu else 4,
+                     prefill_buckets=(prompt_len,) if on_tpu else (8,)))
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size, prompt_len).tolist()
+               for _ in range(n_requests)]
+    # Warm the two compiled shapes (prefill bucket + decode step).
+    w = engine.submit(prompts[0], 2)
+    while w.finished_at is None:
+        engine.step()
+
+    reqs = [engine.submit(p, new_tokens) for p in prompts]
+    t0 = time.perf_counter()
+    while any(r.finished_at is None for r in reqs):
+        engine.step()
+    wall = time.perf_counter() - t0
+
+    out_tokens = sum(r.emitted for r in reqs)
+    ttfts = sorted((r.first_token_at - t0) * 1e3 for r in reqs)
+    tpots = []
+    for r in reqs:
+        if r.emitted > 1:
+            tpots.append((r.finished_at - r.first_token_at) * 1e3 /
+                         (r.emitted - 1))
+    tpots.sort()
+    return {
+        'req_per_s': round(n_requests / wall, 2),
+        'out_tok_per_s': round(out_tokens / wall, 1),
+        'ttft_median_ms': round(ttfts[len(ttfts) // 2], 2),
+        'tpot_median_ms': round(tpots[len(tpots) // 2], 2),
+        'n_slots': n_slots,
+        'prompt_len': prompt_len,
+        'new_tokens': new_tokens,
+        'baseline': 'JetStream Llama-2-7B v6e: 11.42 req/s, 2147.98 '
+                    'out tok/s, TPOT 18.88 ms '
+                    '(examples/tpu/v6e/README.md:119-127)',
+    }
+
+
+def main() -> None:
+    on_tpu = jax.default_backend() == 'tpu'
+    train = bench_train(on_tpu)
+    serve = bench_serve(on_tpu)
     print(json.dumps({
         'metric': 'llama_train_mfu_single_chip',
-        'value': round(mfu, 2),
+        'value': train['mfu_pct'],
         'unit': '%MFU',
-        'vs_baseline': round(mfu / REFERENCE_MFU, 2),
+        'vs_baseline': round(train['mfu_pct'] / REFERENCE_MFU, 2),
         'detail': {
-            'model_params_m': round(n_params / 1e6, 1),
-            'tokens_per_s': round(tokens_per_s, 1),
-            'model_tflops_per_s': round(model_tflops, 2),
-            'chip_peak_tflops': peak,
-            'step_time_ms': round(dt * 1e3, 2),
-            'seq_len': seq,
-            'batch': batch,
+            'train': train,
+            'serve': serve,
             'baseline': 'reference Llama-3-8B PyTorch/XLA FSDP v6e-8 '
                         '= 2.225% MFU (examples/tpu/v6e/README.md:34-48)',
         },

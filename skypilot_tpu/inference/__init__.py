@@ -1,0 +1,11 @@
+"""TPU-native serving engine (JetStream twin).
+
+The reference serves LLMs by launching third-party engines (JetStream,
+vLLM) from recipe YAMLs (examples/tpu/v6e/serve-llama2-7b.yaml,
+llm/vllm/serve.yaml); here the engine is first-party: a continuous-
+batching decode loop over the models' KV caches, plus an HTTP completions
+server that slots into `serve` as the replica workload.
+"""
+from skypilot_tpu.inference.engine import DecodeEngine, EngineConfig
+
+__all__ = ['DecodeEngine', 'EngineConfig']

@@ -1,0 +1,113 @@
+"""HTTP completions server over the decode engine.
+
+The serve replica workload (analog of the reference's JetStream server
+launched by examples/tpu/v6e/serve-llama2-7b.yaml).  Routes:
+
+- GET  /health        -> 200 once the engine thread is up (readiness
+                         probes from serve's replica manager hit this).
+- POST /v1/completions  {"prompt": "...", "max_tokens": N} or
+                        {"prompt_ids": [...], "max_tokens": N}
+                        -> {"ids": [...], "text": "...", "usage": {...}}
+
+Text prompts use a byte-level tokenizer (token id = byte value), which is
+model-agnostic and dependency-free; real deployments pass `prompt_ids`
+from their own tokenizer.
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+from typing import List
+
+from aiohttp import web
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.inference.engine import DecodeEngine, EngineConfig
+
+logger = sky_logging.init_logger(__name__)
+
+
+def encode_bytes(text: str) -> List[int]:
+    return list(text.encode('utf-8'))
+
+
+def decode_bytes(ids: List[int]) -> str:
+    return bytes(i for i in ids if 0 <= i < 256).decode('utf-8',
+                                                        errors='replace')
+
+
+def build_app(engine: DecodeEngine) -> web.Application:
+    app = web.Application()
+
+    async def health(_request):
+        if not engine.healthy:
+            return web.json_response(
+                {'status': 'error', 'error': repr(engine.error)},
+                status=503)
+        return web.json_response({'status': 'ok'})
+
+    async def completions(request):
+        try:
+            body = await request.json()
+        except Exception:  # pylint: disable=broad-except
+            return web.json_response({'error': 'invalid JSON'}, status=400)
+        ids = body.get('prompt_ids')
+        if ids is None:
+            prompt = body.get('prompt')
+            if not isinstance(prompt, str):
+                return web.json_response(
+                    {'error': 'need "prompt" or "prompt_ids"'}, status=400)
+            ids = encode_bytes(prompt)
+        max_tokens = int(body.get('max_tokens', 64))
+        try:
+            req = engine.submit(ids, max_tokens)
+        except ValueError as e:
+            return web.json_response({'error': str(e)}, status=400)
+        out = await asyncio.get_event_loop().run_in_executor(
+            None, req.tokens)
+        return web.json_response({
+            'ids': out,
+            'text': decode_bytes(out),
+            'usage': {
+                'prompt_tokens': len(ids),
+                'completion_tokens': len(out),
+                'ttft_ms': round(
+                    (req.first_token_at - req.submitted_at) * 1e3, 2)
+                if req.first_token_at else None,
+            },
+        })
+
+    app.router.add_get('/health', health)
+    app.router.add_post('/v1/completions', completions)
+    return app
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--model', default='bench-600m')
+    parser.add_argument('--port', type=int,
+                        default=int(os.environ.get(
+                            'SKYTPU_SERVE_REPLICA_PORT', '8200')))
+    parser.add_argument('--n-slots', type=int, default=8)
+    parser.add_argument('--max-seq-len', type=int, default=1024)
+    args = parser.parse_args()
+
+    import dataclasses
+    import jax
+    from skypilot_tpu.models.llama import LLAMA_CONFIGS, Llama, init_params
+
+    cfg = dataclasses.replace(LLAMA_CONFIGS[args.model],
+                              max_seq_len=args.max_seq_len)
+    model = Llama(cfg)
+    params = init_params(model, jax.random.PRNGKey(0))['params']
+    engine = DecodeEngine(model, params,
+                          EngineConfig(n_slots=args.n_slots))
+    engine.start()
+    logger.info(f'serving {args.model} on :{args.port} '
+                f'({args.n_slots} slots)')
+    web.run_app(build_app(engine), port=args.port, print=None)
+
+
+if __name__ == '__main__':
+    main()

@@ -41,6 +41,11 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16          # compute dtype
     param_dtype: Any = jnp.float32
     remat: bool = True                 # checkpoint each block
+    # What the per-block checkpoint keeps: 'none' recomputes everything
+    # (min HBM), 'dots' saves matmul outputs and recomputes elementwise
+    # only (~flops of a plain fwd in bwd; the right default once flash
+    # attention stopped being the memory hog).
+    remat_policy: str = 'none'         # 'none' | 'dots'
     attention_impl: str = 'flash'      # 'flash' | 'xla' | 'ring'
 
     @property
@@ -75,6 +80,11 @@ LLAMA_CONFIGS: Dict[str, LlamaConfig] = {
     'bench-600m': LlamaConfig(vocab_size=32768, dim=1536, n_layers=16,
                               n_heads=12, n_kv_heads=4, ffn_dim=6144,
                               max_seq_len=2048),
+    # HBM-sized single-chip bench model: ~948M params, 11.4 GB optimizer
+    # state in f32 Adam; head_dim 128 keeps the flash kernel lane-aligned
+    'bench-1b': LlamaConfig(vocab_size=32768, dim=2048, n_layers=14,
+                            n_heads=16, n_kv_heads=8, ffn_dim=8192,
+                            max_seq_len=4096, tie_embeddings=True),
     # graft-entry model: modest size so single-chip compile checks are fast
     'llama-250m': LlamaConfig(vocab_size=32000, dim=1024, n_layers=16,
                               n_heads=16, n_kv_heads=8, ffn_dim=4096,
@@ -184,7 +194,7 @@ class Attention(nn.Module):
         k = _rope(k, positions, cfg.rope_theta)
 
         if decode:
-            k, v, attn_out = self._decode_attend(q, k, v)
+            k, v, attn_out = self._decode_attend(q, k, v, positions)
         else:
             attn_out = self._attend(q, k, v)
         out = attn_out.transpose(0, 2, 1, 3)  # [B, S, H, D]
@@ -205,8 +215,17 @@ class Attention(nn.Module):
             return attn_lib.flash_attention(q, k, v, True)
         return attn_lib.mha_reference(q, k, v, causal=True)
 
-    def _decode_attend(self, q, k, v):
-        """Single-step decode with a KV cache (serving path)."""
+    def _decode_attend(self, q, k, v, positions):
+        """Decode with a KV cache (serving path), driven entirely by the
+        caller-supplied per-slot `positions` [B, S] — there is no shared
+        index, so a continuous-batching engine can run heterogeneous slot
+        lengths in one batch (each slot writes at its own position).
+
+        Invariant that makes bucket-padded prefill safe: every step
+        attends only k_pos <= q_pos, writes at q_pos, and inserts
+        overwrite a slot's whole cache — so padding garbage always lives
+        at k_pos > q_pos and is masked until overwritten.
+        """
         cfg = self.cfg
         is_init = not self.has_variable('cache', 'k')
         max_len = cfg.max_seq_len
@@ -217,39 +236,34 @@ class Attention(nn.Module):
         cv = self.variable('cache', 'v', jnp.zeros,
                            (b, cfg.n_kv_heads, max_len, cfg.head_dim),
                            cfg.dtype)
-        idx = self.variable('cache', 'index',
-                            lambda: jnp.zeros((), jnp.int32))
-        # Write incoming k/v and advance the index on BOTH the init and
-        # steady-state paths: the standard prefill pattern is a first
-        # apply(decode=True) over the full prompt, which must land the
-        # prompt's K/V in the cache (a silently-empty cache would make all
-        # later decode steps attend to zeros).
+        # Write incoming k/v on BOTH the init and steady-state paths: the
+        # standard prefill pattern is a first apply(decode=True) over the
+        # full prompt, which must land the prompt's K/V in the cache (a
+        # silently-empty cache would make later decode steps attend to
+        # zeros).
         if is_init:
-            # Fast path: the cache was just created, so cur is statically
-            # 0 and the prompt occupies cache[:S].  Attend causal over the
-            # prompt itself — O(S^2), not O(S * max_len).
+            # Prefill fast path: the cache was just created, prompts are
+            # left-aligned so the prompt occupies cache[:S].  Attend
+            # causal over the prompt itself — O(S^2), not O(S * max_len).
             ck.value = jax.lax.dynamic_update_slice(
                 ck.value, k, (0, 0, 0, 0))
             cv.value = jax.lax.dynamic_update_slice(
                 cv.value, v, (0, 0, 0, 0))
-            idx.value = jnp.asarray(q.shape[2], jnp.int32)
             return k, v, attn_lib.mha_reference(q, k, v, causal=True)
-        cur = idx.value
-        ck.value = jax.lax.dynamic_update_slice(
-            ck.value, k, (0, 0, cur, 0))
-        cv.value = jax.lax.dynamic_update_slice(
-            cv.value, v, (0, 0, cur, 0))
-        idx.value = cur + q.shape[2]
+        # Steady state (S == 1 per slot): scatter-write each slot's k/v at
+        # its own position via a one-hot blend (elementwise over the
+        # cache — the same HBM traffic the attention read pays anyway).
+        pos = positions[:, 0]                                   # [B]
+        oh = jax.nn.one_hot(pos, max_len, dtype=ck.value.dtype)  # [B, L]
+        oh = oh[:, None, :, None]                               # [B,1,L,1]
+        ck.value = ck.value * (1.0 - oh) + k * oh
+        cv.value = cv.value * (1.0 - oh) + v * oh
         k_all, v_all = ck.value, cv.value
-        q_pos = cur + jnp.arange(q.shape[2])[None, :]
         k_pos = jnp.arange(max_len)[None, :]
-        # mask future cache slots via positions
         out = attn_lib.mha_reference(
             q, k_all, v_all, causal=True,
-            segment_positions=jnp.broadcast_to(q_pos, (q.shape[0],) +
-                                               q_pos.shape[1:]),
-            kv_positions=jnp.broadcast_to(k_pos,
-                                          (q.shape[0], max_len)))
+            segment_positions=positions,
+            kv_positions=jnp.broadcast_to(k_pos, (b, max_len)))
         return k_all, v_all, out
 
 
@@ -313,9 +327,12 @@ class Llama(nn.Module):
         x = embed(tokens)
         block = Block
         if cfg.remat and not decode:
+            policy = (jax.checkpoint_policies.nothing_saveable
+                      if cfg.remat_policy == 'none' else
+                      jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
             block = nn.remat(
                 Block, static_argnums=(3,),  # (self, x, positions, decode)
-                policy=jax.checkpoint_policies.nothing_saveable)
+                policy=policy)
         for i in range(cfg.n_layers):
             x = block(cfg, self.mesh, name=f'layer_{i}')(
                 x, positions, decode)
