@@ -1,0 +1,101 @@
+"""Prometheus metrics for the API server (parity: sky/server/metrics.py).
+
+No prometheus_client dependency: the registry renders the text
+exposition format directly (counters + gauges + duration summaries are
+all this server needs).  Scrape GET /metrics.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Tuple
+
+_lock = threading.Lock()
+# (metric, labels-tuple) -> float
+_counters: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
+_gauges: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
+# (metric, labels) -> (count, sum)
+_summaries: Dict[Tuple[str, Tuple[Tuple[str, str], ...]],
+                 List[float]] = {}
+
+_HELP = {
+    'skytpu_requests_total':
+        'API requests by route handler and terminal status',
+    'skytpu_requests_in_flight': 'Requests currently executing',
+    'skytpu_request_duration_seconds': 'Request wall time',
+    'skytpu_server_start_time_seconds': 'Unix time the server started',
+}
+
+_started_at = time.time()
+
+
+def _key(metric: str, labels: dict):
+    return (metric, tuple(sorted(labels.items())))
+
+
+def inc_counter(metric: str, value: float = 1.0, **labels: str) -> None:
+    with _lock:
+        k = _key(metric, labels)
+        _counters[k] = _counters.get(k, 0.0) + value
+
+
+def set_gauge(metric: str, value: float, **labels: str) -> None:
+    with _lock:
+        _gauges[_key(metric, labels)] = value
+
+
+def add_gauge(metric: str, delta: float, **labels: str) -> None:
+    with _lock:
+        k = _key(metric, labels)
+        _gauges[k] = _gauges.get(k, 0.0) + delta
+
+
+def observe(metric: str, value: float, **labels: str) -> None:
+    with _lock:
+        k = _key(metric, labels)
+        if k not in _summaries:
+            _summaries[k] = [0.0, 0.0]
+        _summaries[k][0] += 1
+        _summaries[k][1] += value
+
+
+def _fmt_labels(labels: Tuple[Tuple[str, str], ...]) -> str:
+    if not labels:
+        return ''
+    inner = ','.join(f'{k}="{v}"' for k, v in labels)
+    return '{' + inner + '}'
+
+
+def render() -> str:
+    """Prometheus text exposition format."""
+    lines: List[str] = []
+    with _lock:
+        emitted = set()
+
+        def header(name: str, mtype: str):
+            if name not in emitted:
+                emitted.add(name)
+                if name in _HELP:
+                    lines.append(f'# HELP {name} {_HELP[name]}')
+                lines.append(f'# TYPE {name} {mtype}')
+
+        header('skytpu_server_start_time_seconds', 'gauge')
+        lines.append(f'skytpu_server_start_time_seconds {_started_at}')
+        for (name, labels), val in sorted(_counters.items()):
+            header(name, 'counter')
+            lines.append(f'{name}{_fmt_labels(labels)} {val}')
+        for (name, labels), val in sorted(_gauges.items()):
+            header(name, 'gauge')
+            lines.append(f'{name}{_fmt_labels(labels)} {val}')
+        for (name, labels), (count, total) in sorted(_summaries.items()):
+            header(name, 'summary')
+            lines.append(f'{name}_count{_fmt_labels(labels)} {count}')
+            lines.append(f'{name}_sum{_fmt_labels(labels)} {total}')
+    return '\n'.join(lines) + '\n'
+
+
+def reset_for_tests() -> None:
+    with _lock:
+        _counters.clear()
+        _gauges.clear()
+        _summaries.clear()
