@@ -1,0 +1,146 @@
+"""Agent-side autostop: persisted config + enforcement event.
+
+Parity: sky/skylet/autostop_lib.py (config) + AutostopEvent
+(sky/skylet/events.py:161).  The config lives in the agent's sqlite so it
+survives agent restarts; an event thread checks idleness periodically and
+— once the idle window is exceeded — stops or tears down the cluster
+*from the cluster itself* via the shipped provisioner (the head host
+carries the framework source and, on GCP, the VM's default credentials;
+that is exactly how the reference's skylet does it).
+
+Stop-vs-down semantics are decided at *set* time by core.autostop (TPU
+pods cannot stop, sky/clouds/gcp.py:219-226 — callers must pass down);
+the agent just executes what was configured.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.agent import job_queue
+from skypilot_tpu.utils import db_utils
+
+logger = sky_logging.init_logger(__name__)
+
+_DDL = [
+    """CREATE TABLE IF NOT EXISTS autostop (
+        id INTEGER PRIMARY KEY CHECK (id = 1),
+        idle_minutes INTEGER NOT NULL,
+        down INTEGER NOT NULL,
+        set_at REAL NOT NULL
+    )""",
+]
+
+
+def _db() -> str:
+    path = job_queue.db_path()
+    db_utils.ensure_schema(path, _DDL)
+    return path
+
+
+def set_config(idle_minutes: int, down: bool) -> None:
+    db_utils.execute(
+        _db(),
+        'INSERT INTO autostop (id, idle_minutes, down, set_at) '
+        'VALUES (1,?,?,?) ON CONFLICT(id) DO UPDATE SET '
+        'idle_minutes=excluded.idle_minutes, down=excluded.down, '
+        'set_at=excluded.set_at',
+        (idle_minutes, int(down), time.time()))
+
+
+def get_config() -> dict:
+    row = db_utils.query_one(_db(), 'SELECT * FROM autostop WHERE id=1')
+    if row is None:
+        return {'idle_minutes': -1, 'down': False}
+    return {'idle_minutes': row['idle_minutes'], 'down': bool(row['down'])}
+
+
+class ClusterIdentity:
+    """Who am I, cloud-wise — injected at agent bootstrap so enforcement
+    can address this cluster through the provision dispatch API."""
+
+    def __init__(self, cluster_name: Optional[str], cloud: Optional[str],
+                 region: Optional[str], zone: Optional[str]) -> None:
+        self.cluster_name = cluster_name
+        self.cloud = cloud
+        self.region = region
+        self.zone = zone
+
+    @property
+    def enforceable(self) -> bool:
+        return bool(self.cluster_name and self.cloud)
+
+
+def idle_seconds(started_at: float) -> float:
+    if job_queue.any_active():
+        return 0.0
+    last = job_queue.last_activity_time() or started_at
+    return time.time() - last
+
+
+def maybe_enforce(identity: ClusterIdentity, started_at: float) -> bool:
+    """One enforcement check.  Returns True if stop/down was executed."""
+    cfg = get_config()
+    if cfg['idle_minutes'] < 0:
+        return False
+    # A running/pending job always blocks enforcement — without this,
+    # idle_minutes=0 would fire mid-job (idle==0.0 satisfies >= 0*60).
+    if job_queue.any_active():
+        return False
+    idle = idle_seconds(started_at)
+    if idle < cfg['idle_minutes'] * 60.0:
+        return False
+    if not identity.enforceable:
+        logger.warning('autostop breached but agent has no cluster '
+                       'identity; cannot enforce')
+        return False
+    from skypilot_tpu import provision as provision_lib
+    action = 'down' if cfg['down'] else 'stop'
+    logger.info(f'autostop: idle {idle:.0f}s >= '
+                f"{cfg['idle_minutes']}min; executing {action} on "
+                f'{identity.cluster_name}')
+    # Disarm first: enforcement must fire exactly once even if the
+    # stop/terminate call takes longer than the event interval — but
+    # re-arm on failure, or one transient cloud error would disable
+    # autostop forever and the idle cluster would bill indefinitely.
+    set_config(-1, cfg['down'])
+    try:
+        if cfg['down']:
+            provision_lib.terminate_instances(
+                identity.cloud, identity.cluster_name,
+                region=identity.region, zone=identity.zone)
+        else:
+            provision_lib.stop_instances(
+                identity.cloud, identity.cluster_name,
+                region=identity.region, zone=identity.zone)
+    except BaseException:
+        set_config(cfg['idle_minutes'], cfg['down'])
+        raise
+    return True
+
+
+class AutostopEvent(threading.Thread):
+    """Periodic enforcement loop (reference ticks every 60s,
+    events.py:161; interval overridable for tests)."""
+
+    def __init__(self, identity: ClusterIdentity, started_at: float) -> None:
+        super().__init__(name='autostop-event', daemon=True)
+        self.identity = identity
+        self.started_at = started_at
+        self.interval = float(
+            os.environ.get('SKYTPU_AGENT_EVENT_INTERVAL', '20'))
+        self._stop = threading.Event()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                maybe_enforce(self.identity, self.started_at)
+            except Exception as e:  # pylint: disable=broad-except
+                logger.warning(f'autostop event error: {e}')
+            self._stop.wait(self.interval)

@@ -10,7 +10,8 @@ real clusters.  Endpoints:
   GET  /jobs/{id}              job record
   POST /jobs/{id}/cancel
   GET  /jobs/{id}/logs?phase=run&rank=0&offset=N   raw log bytes
-  POST /autostop               {idle_minutes, down}  (bookkeeping)
+  POST /autostop               {idle_minutes, down}  (persisted + enforced
+                               by agent/autostop.py AutostopEvent)
 """
 from __future__ import annotations
 
@@ -23,6 +24,7 @@ from typing import Any, Dict, Optional
 
 from aiohttp import web
 
+from skypilot_tpu.agent import autostop as autostop_lib
 from skypilot_tpu.agent import gang, job_queue
 
 
@@ -97,21 +99,31 @@ def _job_json(job: Dict[str, Any]) -> Dict[str, Any]:
     return out
 
 
-def make_app(scheduler: Optional[AgentScheduler] = None) -> web.Application:
+def make_app(scheduler: Optional[AgentScheduler] = None,
+             identity: Optional[autostop_lib.ClusterIdentity] = None
+             ) -> web.Application:
     sched = scheduler or AgentScheduler()
     sched.start()
     app = web.Application()
     app['scheduler'] = sched
-    app['autostop'] = {'idle_minutes': -1, 'down': False}
     started_at = time.time()
+    identity = identity or autostop_lib.ClusterIdentity(
+        None, None, None, None)
+    event = autostop_lib.AutostopEvent(identity, started_at)
+    event.start()
+    app['autostop_event'] = event
+
+    async def _stop_event(_app):
+        event.stop()
+        sched.stop()
+
+    app.on_cleanup.append(_stop_event)
 
     async def health(request):
-        last = job_queue.last_activity_time() or started_at
-        idle = 0.0 if job_queue.any_active() else time.time() - last
         return web.json_response({
             'ok': True,
-            'idle_seconds': idle,
-            'autostop': request.app['autostop'],
+            'idle_seconds': autostop_lib.idle_seconds(started_at),
+            'autostop': autostop_lib.get_config(),
         })
 
     async def submit(request):
@@ -155,10 +167,8 @@ def make_app(scheduler: Optional[AgentScheduler] = None) -> web.Application:
 
     async def autostop(request):
         body = await request.json()
-        request.app['autostop'] = {
-            'idle_minutes': int(body.get('idle_minutes', -1)),
-            'down': bool(body.get('down', False)),
-        }
+        autostop_lib.set_config(int(body.get('idle_minutes', -1)),
+                                bool(body.get('down', False)))
         return web.json_response({'ok': True})
 
     app.router.add_get('/health', health)
@@ -176,8 +186,16 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument('--port', type=int, default=8790)
     parser.add_argument('--host', default='127.0.0.1')
+    # Cluster identity: lets the AutostopEvent address this cluster
+    # through the provision dispatch API (see agent/autostop.py).
+    parser.add_argument('--cluster-name', default=None)
+    parser.add_argument('--cloud', default=None)
+    parser.add_argument('--region', default=None)
+    parser.add_argument('--zone', default=None)
     args = parser.parse_args()
-    web.run_app(make_app(), host=args.host, port=args.port,
+    identity = autostop_lib.ClusterIdentity(args.cluster_name, args.cloud,
+                                            args.region, args.zone)
+    web.run_app(make_app(identity=identity), host=args.host, port=args.port,
                 print=lambda *a: None)
 
 

@@ -1,0 +1,113 @@
+"""Autostop enforcement tests: the agent-side AutostopEvent actually
+stops/downs an idle cluster (the reference's AutostopEvent,
+sky/skylet/events.py:161), and the config survives agent restarts
+(autostop_lib persistence)."""
+import time
+
+import pytest
+
+from skypilot_tpu import core
+from skypilot_tpu import execution
+from skypilot_tpu import global_user_state
+from skypilot_tpu.agent import autostop as autostop_lib
+from skypilot_tpu.backends import backend_utils
+from skypilot_tpu.global_user_state import ClusterStatus
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.task import Task
+
+
+@pytest.fixture
+def fast_events(monkeypatch):
+    monkeypatch.setenv('SKYTPU_AGENT_EVENT_INTERVAL', '0.3')
+
+
+@pytest.fixture
+def local_task(tmp_home, enable_all_clouds, fast_events):
+    def make(run='echo ok', name='t', **kwargs):
+        t = Task(name, run=run, **kwargs)
+        t.set_resources(Resources.from_yaml_config({'infra': 'local'}))
+        return t
+    return make
+
+
+def _wait_status(name, want, timeout=15.0):
+    deadline = time.time() + timeout
+    status = 'never-refreshed'
+    while time.time() < deadline:
+        status = backend_utils.refresh_cluster_status(name)
+        if status is want:
+            return
+        time.sleep(0.3)
+    raise AssertionError(f'{name}: wanted {want}, stuck at {status}')
+
+
+def test_autostop_enforced_stop(local_task):
+    execution.launch(local_task(), 'idle-stop', quiet_optimizer=True)
+    core.autostop('idle-stop', idle_minutes=0, down_flag=False)
+    # Agent's AutostopEvent (0.3s tick) sees idle >= 0 min and stops the
+    # cluster through the provisioner; server-side refresh observes it.
+    _wait_status('idle-stop', ClusterStatus.STOPPED)
+    core.down('idle-stop')
+
+
+def test_autostop_enforced_down(local_task):
+    execution.launch(local_task(), 'idle-down', quiet_optimizer=True)
+    core.autostop('idle-down', idle_minutes=0, down_flag=True)
+    _wait_status('idle-down', None)
+    assert global_user_state.get_cluster('idle-down') is None
+
+
+def test_autostop_not_triggered_while_job_runs(local_task):
+    # A running job pins idle_seconds to 0, so a 0-minute autostop must
+    # not fire mid-job.
+    job_id, _ = execution.launch(local_task(run='sleep 3', name='busy'),
+                                 'busy-cl', quiet_optimizer=True,
+                                 detach_run=True)
+    core.autostop('busy-cl', idle_minutes=0, down_flag=False)
+    time.sleep(1.0)   # several event ticks while the job is running
+    assert backend_utils.refresh_cluster_status('busy-cl') is \
+        ClusterStatus.UP
+    _wait_status('busy-cl', ClusterStatus.STOPPED)
+    core.down('busy-cl')
+
+
+def test_autostop_config_persists(tmp_home, monkeypatch):
+    monkeypatch.setenv('SKYTPU_AGENT_HOME', str(tmp_home / 'agent'))
+    from skypilot_tpu.utils import db_utils
+    autostop_lib.set_config(30, True)
+    db_utils.reset_connections_for_tests()   # simulate agent restart
+    assert autostop_lib.get_config() == {'idle_minutes': 30, 'down': True}
+
+
+def test_maybe_enforce_rearms_on_failure(tmp_home, monkeypatch):
+    # A transient cloud error must not permanently disarm autostop.
+    monkeypatch.setenv('SKYTPU_AGENT_HOME', str(tmp_home / 'agent'))
+    calls = []
+
+    def flaky(cloud, name, region=None, zone=None):
+        calls.append(name)
+        if len(calls) == 1:
+            raise RuntimeError('transient 503')
+
+    monkeypatch.setattr('skypilot_tpu.provision.stop_instances', flaky)
+    autostop_lib.set_config(0, False)
+    ident = autostop_lib.ClusterIdentity('c1', 'local', 'r', 'z')
+    with pytest.raises(RuntimeError):
+        autostop_lib.maybe_enforce(ident, time.time() - 60)
+    assert autostop_lib.get_config()['idle_minutes'] == 0  # re-armed
+    assert autostop_lib.maybe_enforce(ident, time.time() - 60)
+    assert calls == ['c1', 'c1']
+
+
+def test_maybe_enforce_fires_once(tmp_home, monkeypatch):
+    monkeypatch.setenv('SKYTPU_AGENT_HOME', str(tmp_home / 'agent'))
+    calls = []
+    monkeypatch.setattr(
+        'skypilot_tpu.provision.stop_instances',
+        lambda cloud, name, region=None, zone=None: calls.append(name))
+    autostop_lib.set_config(0, False)
+    ident = autostop_lib.ClusterIdentity('c1', 'local', 'r', 'z')
+    assert autostop_lib.maybe_enforce(ident, time.time() - 60)
+    # Disarmed after firing: a second tick is a no-op.
+    assert not autostop_lib.maybe_enforce(ident, time.time() - 60)
+    assert calls == ['c1']
