@@ -7,10 +7,18 @@ provision failure it blocklists the zone (stockout) or the whole region
 re-optimizes with the accumulated blocklist and tries the next placement.
 Each failure is recorded in the failover history surfaced to the user on
 final failure.
+
+`retry_until_up` (reference: `sky launch --retry-until-up`,
+provision_with_retries looping at cloud_vm_ray_backend.py:1638): when one
+full sweep over every placement fails, forget the sweep's stockout
+blocklist (capacity comes and goes), sleep a gap, and sweep again —
+forever, until something provisions.
 """
 from __future__ import annotations
 
 import dataclasses
+import os
+import time
 from typing import Callable, List, Optional
 
 from skypilot_tpu import exceptions
@@ -20,6 +28,7 @@ from skypilot_tpu.optimizer import Optimizer, OptimizeTarget
 from skypilot_tpu.provision import common as provision_common
 from skypilot_tpu import dag as dag_lib
 from skypilot_tpu import sky_logging
+from skypilot_tpu.utils import timeline
 
 logger = sky_logging.init_logger(__name__)
 
@@ -41,6 +50,12 @@ def _blocklist_entry(
     return resources_lib.Resources.from_yaml_config({'infra': infra})
 
 
+def retry_gap_seconds() -> float:
+    """Sleep between retry_until_up sweeps (reference waits a gap before
+    re-sweeping placements)."""
+    return float(os.environ.get('SKYTPU_RETRY_UNTIL_UP_GAP_S', '60'))
+
+
 def provision_with_retries(
     task: task_lib.Task,
     cluster_name: str,
@@ -49,6 +64,8 @@ def provision_with_retries(
     max_attempts: int = 16,
     blocked_resources: Optional[List[resources_lib.Resources]] = None,
     cleanup_fn: Optional[Callable[[resources_lib.Resources], None]] = None,
+    retry_until_up: bool = False,
+    max_rounds: Optional[int] = None,
 ) -> ProvisionAttemptResult:
     """Try placements until one provisions.
 
@@ -60,43 +77,71 @@ def provision_with_retries(
     provisioned nodes / parked queued-resources in the failed zone are
     deleted before failing over (otherwise a later-ACTIVE queued resource
     materializes a billed slice no teardown path can reach).
+
+    retry_until_up: instead of raising when a sweep exhausts every
+    placement, drop the sweep's blocklist (quota blocks persist — quota
+    does not free itself the way capacity does), sleep retry_gap_seconds()
+    and sweep again.  max_rounds bounds this for tests; None = forever.
     """
-    blocked: List[resources_lib.Resources] = list(blocked_resources or [])
-    history: List[Exception] = []
-    for attempt in range(max_attempts):
-        single = dag_lib.dag_from_task(task)
-        try:
-            Optimizer.optimize(single, minimize=OptimizeTarget.COST,
-                               blocked_resources=blocked, quiet=True)
-        except exceptions.ResourcesUnavailableError as e:
+    permanent: List[resources_lib.Resources] = list(blocked_resources or [])
+    round_no = 0
+    history: List[Exception] = []   # accumulated across ALL rounds
+    while True:
+        round_no += 1
+        blocked = list(permanent)
+        exhausted: Optional[Exception] = None
+        for attempt in range(max_attempts):
+            single = dag_lib.dag_from_task(task)
+            try:
+                Optimizer.optimize(single, minimize=OptimizeTarget.COST,
+                                   blocked_resources=blocked, quiet=True)
+            except exceptions.ResourcesUnavailableError as e:
+                exhausted = e
+                break
+            candidate = task.best_resources
+            assert candidate is not None
+            try:
+                with timeline.Event('failover.attempt',
+                                    region=str(candidate.region),
+                                    zone=str(candidate.zone)):
+                    record = provision_fn(candidate)
+                return ProvisionAttemptResult(record, candidate)
+            except exceptions.ProvisionError as e:
+                history.append(e)
+                if cleanup_fn is not None:
+                    try:
+                        cleanup_fn(candidate)
+                    except Exception as cleanup_err:  # pylint: disable=broad-except
+                        logger.warning(
+                            f'cleanup after failed attempt in '
+                            f'{candidate.zone} failed: {cleanup_err}')
+                entry = _blocklist_entry(candidate, e.blocklist_region)
+                blocked.append(entry)
+                if e.blocklist_region:
+                    # Quota: permanent across retry_until_up rounds.
+                    permanent.append(entry)
+                scope = 'region' if e.blocklist_region else 'zone'
+                logger.warning(
+                    f'Provision attempt {attempt + 1} in '
+                    f'{candidate.region}/{candidate.zone} failed '
+                    f'({type(e).__name__}); blocklisting {scope} and '
+                    f'failing over.')
+        # A round that never attempted anything means every placement is
+        # permanently blocked (quota) — waiting cannot help; raise even
+        # under retry_until_up.
+        nothing_attemptable = (exhausted is not None and
+                               len(blocked) == len(permanent))
+        if not retry_until_up or nothing_attemptable or \
+                (max_rounds is not None and round_no >= max_rounds):
+            n = len(history)
             raise exceptions.ResourcesUnavailableError(
                 f'Provisioning {cluster_name!r} failed after exhausting '
-                f'all placements ({attempt} attempts).\n'
+                f'all placements ({n} attempts'
+                f'{f", {round_no} rounds" if round_no > 1 else ""}).\n'
                 + exceptions.format_failover_history(history)
-            ).with_failover_history(history) from e
-        candidate = task.best_resources
-        assert candidate is not None
-        try:
-            record = provision_fn(candidate)
-            return ProvisionAttemptResult(record, candidate)
-        except exceptions.ProvisionError as e:
-            history.append(e)
-            if cleanup_fn is not None:
-                try:
-                    cleanup_fn(candidate)
-                except Exception as cleanup_err:  # pylint: disable=broad-except
-                    logger.warning(
-                        f'cleanup after failed attempt in '
-                        f'{candidate.zone} failed: {cleanup_err}')
-            entry = _blocklist_entry(candidate, e.blocklist_region)
-            blocked.append(entry)
-            scope = 'region' if e.blocklist_region else 'zone'
-            logger.warning(
-                f'Provision attempt {attempt + 1} in '
-                f'{candidate.region}/{candidate.zone} failed '
-                f'({type(e).__name__}); blocklisting {scope} and '
-                f'failing over.')
-    raise exceptions.ResourcesUnavailableError(
-        f'Provisioning {cluster_name!r} failed: {max_attempts} attempts '
-        f'exhausted.\n' + exceptions.format_failover_history(history)
-    ).with_failover_history(history)
+            ).with_failover_history(history) from exhausted
+        gap = retry_gap_seconds()
+        logger.warning(
+            f'retry_until_up: round {round_no} exhausted every placement '
+            f'for {cluster_name!r}; retrying in {gap:.0f}s.')
+        time.sleep(gap)

@@ -1,9 +1,15 @@
-"""GCP TPU provisioner implementing the dispatch API.
+"""GCP provisioner implementing the dispatch API: TPU slices + CPU VMs.
 
 One logical node == one TPU resource (a whole slice; multi-host slices get
 all their host VMs atomically from the TPU API — no per-VM gang scheduling
 needed, unlike the reference's GPU path).  Node naming:
 ``<cluster>-<i>`` for node i; queued-resource ids mirror node ids.
+
+Resources without a TPU route to Compute Engine (gce_client.py — the
+reference's GCPComputeInstance, sky/provision/gcp/instance_utils.py:311):
+serve LBs/controllers and CPU-only tasks.  The read/teardown paths
+(query/stop/terminate/get_cluster_info) consult both services and merge,
+since the dispatch API addresses clusters by name only.
 
 TPU semantics carried from the reference:
 - pods (multi-host) cannot stop — only delete (sky/clouds/gcp.py:219-226);
@@ -14,12 +20,14 @@ TPU semantics carried from the reference:
 """
 from __future__ import annotations
 
+import os
 import time
 from typing import Dict, List, Optional
 
 from skypilot_tpu import exceptions
 from skypilot_tpu import resources as resources_lib
 from skypilot_tpu.provision import common
+from skypilot_tpu.provision.gcp import gce_client as gce_client_lib
 from skypilot_tpu.provision.gcp import tpu_client as tpu_client_lib
 
 # TPU node states → framework InstanceStatus.
@@ -36,11 +44,28 @@ _STATE_MAP = {
     'DELETING': common.InstanceStatus.TERMINATED,
 }
 
+# GCE instance states → framework InstanceStatus.  Note GCE reports a
+# *stopped* VM as TERMINATED (the disk survives; the instance restarts).
+_GCE_STATE_MAP = {
+    'PROVISIONING': common.InstanceStatus.PENDING,
+    'STAGING': common.InstanceStatus.PENDING,
+    'REPAIRING': common.InstanceStatus.PENDING,
+    'RUNNING': common.InstanceStatus.RUNNING,
+    'STOPPING': common.InstanceStatus.STOPPED,
+    'SUSPENDING': common.InstanceStatus.STOPPED,
+    'SUSPENDED': common.InstanceStatus.STOPPED,
+    'TERMINATED': common.InstanceStatus.STOPPED,
+}
+
 _CLUSTER_LABEL = 'skytpu-cluster'
 
 
 def _client() -> tpu_client_lib.TpuClient:
     return tpu_client_lib.TpuClient(tpu_client_lib.default_project())
+
+
+def _gce_client() -> gce_client_lib.GceClient:
+    return gce_client_lib.GceClient(tpu_client_lib.default_project())
 
 
 def _node_id(cluster_name: str, i: int) -> str:
@@ -66,9 +91,7 @@ def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
         dict(config.resources_config))
     tpu = res.tpu
     if tpu is None:
-        raise exceptions.ProvisionError(
-            'GCP provisioner currently provisions TPU slices only; '
-            'CPU controllers run on the local cloud or kubernetes.')
+        return _run_gce_instances(config, res)
     client = _client()
     zone = config.zone
     existing = _cluster_nodes(client, zone, config.cluster_name)
@@ -122,6 +145,78 @@ def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
                                   resumed=resumed)
 
 
+def _gce_cluster_instances(client: gce_client_lib.GceClient, zone: str,
+                           cluster_name: str) -> Dict[str, dict]:
+    out = {}
+    for inst in client.list_instances(zone):
+        if inst.get('labels', {}).get(_CLUSTER_LABEL) == cluster_name:
+            out[inst['name']] = inst
+    return out
+
+
+def _run_gce_instances(config: common.ProvisionConfig,
+                       res: resources_lib.Resources
+                       ) -> common.ProvisionRecord:
+    """CPU-VM path (reference: GCPComputeInstance.create_instances,
+    instance_utils.py:311-788)."""
+    machine_type = res.instance_type
+    if machine_type is None:
+        from skypilot_tpu.catalog import gcp_catalog
+        machine_type = gcp_catalog.get_default_instance_type(
+            res.cpus, res.memory)
+    if machine_type is None:
+        raise exceptions.ProvisionError(
+            f'no GCE machine type satisfies cpus={res.cpus} '
+            f'memory={res.memory}')
+    client = _gce_client()
+    zone = config.zone
+    existing = _gce_cluster_instances(client, zone, config.cluster_name)
+    labels = dict(config.labels)
+    labels[_CLUSTER_LABEL] = config.cluster_name
+    metadata = {}
+    if config.authorized_key:
+        metadata['ssh-keys'] = f'skytpu:{config.authorized_key}'
+
+    instance_ids = []
+    to_create = []
+    resumed = False
+    for i in range(config.num_nodes):
+        name = _node_id(config.cluster_name, i)
+        instance_ids.append(name)
+        inst = existing.get(name)
+        status = inst.get('status') if inst else None
+        if status in ('RUNNING', 'PROVISIONING', 'STAGING'):
+            resumed = True
+            continue
+        if status in ('TERMINATED', 'STOPPING'):
+            # GCE TERMINATED == stopped-with-disk: restart in place.  An
+            # in-flight stop must settle first — start on a STOPPING
+            # instance is a 400 on the real API.
+            if status == 'STOPPING':
+                client.wait_instance_status(zone, name, ('TERMINATED',))
+            client.start_instance(zone, name)
+            resumed = True
+            continue
+        if status in ('SUSPENDED', 'SUSPENDING'):
+            if status == 'SUSPENDING':
+                client.wait_instance_status(zone, name, ('SUSPENDED',))
+            client.resume_instance(zone, name)
+            resumed = True
+            continue
+        to_create.append(name)
+    if len(to_create) == 1:
+        client.create_instance(zone, to_create[0], machine_type,
+                               spot=res.use_spot, labels=labels,
+                               metadata=metadata)
+    elif to_create:
+        client.bulk_create_instances(zone, to_create, machine_type,
+                                     spot=res.use_spot, labels=labels,
+                                     metadata=metadata)
+    return common.ProvisionRecord('gcp', config.cluster_name,
+                                  config.region, zone, instance_ids,
+                                  resumed=resumed)
+
+
 def _cluster_queued_resources(client: tpu_client_lib.TpuClient, zone: str,
                               cluster_name: str) -> List[str]:
     out = []
@@ -133,20 +228,85 @@ def _cluster_queued_resources(client: tpu_client_lib.TpuClient, zone: str,
     return out
 
 
+def _service_unconfigured(e: Exception) -> bool:
+    """True iff the error means this deployment simply has no access to
+    that service (no project/credentials) — by-design absence.  Anything
+    else (500s, timeouts, auth blips) is a REAL error: treating it as
+    'no instances' would let teardown silently leak billed resources and
+    status refresh remove live clusters."""
+    if isinstance(e, exceptions.NoCloudAccessError):
+        return True
+    # DefaultCredentialsError = no credentials at all (by-design absence).
+    # RefreshError is NOT here: credentials exist but refresh failed —
+    # a transient auth problem that must surface, not read as empty.
+    return type(e).__name__ == 'DefaultCredentialsError'
+
+
+def _query_both(cluster_name: str, zone: str):
+    """(tpu_nodes, gce_instances).  A side whose service is not
+    configured for this deployment (CPU-only: no TPU API; TPU-only: no
+    GCE) reads as empty; a side that is configured but *fails* raises —
+    callers must not mistake an outage for an empty cluster."""
+    unconfigured = []
+    tpu_nodes: Dict[str, dict] = {}
+    gce_insts: Dict[str, dict] = {}
+    try:
+        tpu_nodes = _cluster_nodes(_client(), zone, cluster_name)
+    except Exception as e:  # pylint: disable=broad-except
+        if not _service_unconfigured(e):
+            raise
+        unconfigured.append(e)
+    try:
+        gce_insts = _gce_cluster_instances(_gce_client(), zone,
+                                           cluster_name)
+    except Exception as e:  # pylint: disable=broad-except
+        if not _service_unconfigured(e):
+            raise
+        unconfigured.append(e)
+    if len(unconfigured) == 2:
+        raise unconfigured[0]
+    return tpu_nodes, gce_insts
+
+
+def _queued_resource_wait_s(default: float) -> float:
+    """Wait-vs-failover policy knob (SURVEY hard-part (d); reference:
+    retry-on-stockout loop, instance_utils.py:1501-1592): how long to park
+    on a queued resource before abandoning the zone.  A long wait bets the
+    zone frees up; a short one lets the failover engine try elsewhere.
+    Config: `gcp.queued_resource_wait_s` (yaml) or
+    SKYTPU_QUEUED_RESOURCE_WAIT_S (env, wins)."""
+    env = os.environ.get('SKYTPU_QUEUED_RESOURCE_WAIT_S')
+    if env is not None:
+        return float(env)
+    from skypilot_tpu import sky_config
+    return float(sky_config.get_nested(('gcp', 'queued_resource_wait_s'),
+                                       default))
+
+
 def wait_instances(cluster_name: str, region=None, zone=None,
                    timeout_s: float = 1800.0) -> None:
-    client = _client()
     # Queued-resource path first: wait until each QR is ACTIVE (the TPU
-    # scheduler materializes the node atomically at that point).
-    for qr_id in _cluster_queued_resources(client, zone, cluster_name):
+    # scheduler materializes the node atomically at that point).  On
+    # timeout, QueuedResourceTimeoutError propagates to the failover
+    # engine, which blocklists this zone, deletes the parked QR
+    # (cleanup_fn) and tries the next placement.
+    try:
+        client = _client()
+        qr_ids = _cluster_queued_resources(client, zone, cluster_name)
+    except Exception as e:  # pylint: disable=broad-except
+        if not _service_unconfigured(e):
+            raise
+        client, qr_ids = None, []   # CPU-only deployment: no TPU API
+    qr_wait = _queued_resource_wait_s(timeout_s)
+    for qr_id in qr_ids:
         client.wait_queued_resource_active(zone, qr_id,
-                                           timeout_s=timeout_s)
+                                           timeout_s=qr_wait)
     deadline = time.time() + timeout_s
     while True:
         statuses = query_instances(cluster_name, region, zone)
         if not statuses:
             raise exceptions.ProvisionError(
-                f'no TPU nodes found for cluster {cluster_name} in {zone}')
+                f'no instances found for cluster {cluster_name} in {zone}')
         if all(s is common.InstanceStatus.RUNNING
                for s in statuses.values()):
             return
@@ -155,7 +315,7 @@ def wait_instances(cluster_name: str, region=None, zone=None,
                 common.InstanceStatus.TERMINATED)}
         if bad:
             raise exceptions.InsufficientCapacityError(
-                f'TPU nodes failed during provisioning: {bad}')
+                f'instances failed during provisioning: {bad}')
         if time.time() > deadline:
             raise exceptions.QueuedResourceTimeoutError(
                 f'cluster {cluster_name} not READY in {timeout_s}s: '
@@ -166,44 +326,65 @@ def wait_instances(cluster_name: str, region=None, zone=None,
 
 def query_instances(cluster_name: str, region=None,
                     zone=None) -> Dict[str, common.InstanceStatus]:
-    client = _client()
-    nodes = _cluster_nodes(client, zone, cluster_name)
-    return {
+    tpu_nodes, gce_insts = _query_both(cluster_name, zone)
+    out = {
         node_id: _STATE_MAP.get(node.get('state', ''),
                                 common.InstanceStatus.PENDING)
-        for node_id, node in nodes.items()
+        for node_id, node in tpu_nodes.items()
     }
+    for name, inst in gce_insts.items():
+        out[name] = _GCE_STATE_MAP.get(inst.get('status', ''),
+                                       common.InstanceStatus.PENDING)
+    return out
 
 
 def stop_instances(cluster_name: str, region=None, zone=None) -> None:
-    client = _client()
-    for node_id, node in _cluster_nodes(client, zone, cluster_name).items():
-        accel = node.get('acceleratorType', '')
-        # Multi-host slice: no stop support in the TPU API.
-        from skypilot_tpu import accelerators as acc_lib
-        if acc_lib.is_tpu(f'tpu-{accel}') and \
-                acc_lib.parse_tpu(f'tpu-{accel}').is_pod:
-            raise exceptions.NotSupportedError(
-                f'TPU pod slice {node_id} ({accel}) cannot be stopped; '
-                'use down instead.')
-        client.stop_node(zone, node_id)
+    tpu_nodes, gce_insts = _query_both(cluster_name, zone)
+    if tpu_nodes:
+        client = _client()
+        for node_id, node in tpu_nodes.items():
+            accel = node.get('acceleratorType', '')
+            # Multi-host slice: no stop support in the TPU API.
+            from skypilot_tpu import accelerators as acc_lib
+            if acc_lib.is_tpu(f'tpu-{accel}') and \
+                    acc_lib.parse_tpu(f'tpu-{accel}').is_pod:
+                raise exceptions.NotSupportedError(
+                    f'TPU pod slice {node_id} ({accel}) cannot be '
+                    'stopped; use down instead.')
+            client.stop_node(zone, node_id)
+    if gce_insts:
+        gce = _gce_client()
+        for name in gce_insts:
+            gce.stop_instance(zone, name)
 
 
 def terminate_instances(cluster_name: str, region=None, zone=None) -> None:
-    client = _client()
+    tpu_nodes, gce_insts = _query_both(cluster_name, zone)
+    try:
+        client = _client()
+        qr_ids = _cluster_queued_resources(client, zone, cluster_name)
+    except Exception as e:  # pylint: disable=broad-except
+        if not _service_unconfigured(e):
+            raise
+        client, qr_ids = None, []
     # Parked queued-resources whose node never materialized need explicit
     # deletion too (otherwise they later claim capacity for a dead cluster).
-    for qr_id in _cluster_queued_resources(client, zone, cluster_name):
+    for qr_id in qr_ids:
         client.delete_queued_resource(zone, qr_id)
-    for node_id in _cluster_nodes(client, zone, cluster_name):
+    for node_id in tpu_nodes:
         client.delete_queued_resource(zone, node_id)
         client.delete_node(zone, node_id)
+    if gce_insts:
+        gce = _gce_client()
+        for name in gce_insts:
+            gce.delete_instance(zone, name)
 
 
 def get_cluster_info(cluster_name: str, region=None,
                      zone=None) -> common.ClusterInfo:
-    client = _client()
+    tpu_nodes, gce_insts = _query_both(cluster_name, zone)
     instances: List[common.InstanceInfo] = []
+
     def _numeric_key(item):
         # '<cluster>-<i>': order by node index, not lexicographically
         # (lexicographic puts node 10 before node 2).
@@ -211,9 +392,7 @@ def get_cluster_info(cluster_name: str, region=None,
         suffix = node_id.rsplit('-', 1)[-1]
         return (int(suffix) if suffix.isdigit() else 1 << 30, node_id)
 
-    for node_id, node in sorted(
-            _cluster_nodes(client, zone, cluster_name).items(),
-            key=_numeric_key):
+    for node_id, node in sorted(tpu_nodes.items(), key=_numeric_key):
         internal, external = [], []
         for ep in node.get('networkEndpoints', []):
             if ep.get('ipAddress'):
@@ -229,6 +408,23 @@ def get_cluster_info(cluster_name: str, region=None,
                 internal_ips=internal,
                 external_ips=external,
                 tags=node.get('labels', {}),
+            ))
+    for name, inst in sorted(gce_insts.items(), key=_numeric_key):
+        internal, external = [], []
+        for nic in inst.get('networkInterfaces', []):
+            if nic.get('networkIP'):
+                internal.append(nic['networkIP'])
+            for access in nic.get('accessConfigs', []):
+                if access.get('natIP'):
+                    external.append(access['natIP'])
+        instances.append(
+            common.InstanceInfo(
+                instance_id=name,
+                status=_GCE_STATE_MAP.get(inst.get('status', ''),
+                                          common.InstanceStatus.PENDING),
+                internal_ips=internal,
+                external_ips=external,
+                tags=inst.get('labels', {}),
             ))
     return common.ClusterInfo('gcp', cluster_name, instances,
                               ssh_user='skytpu')

@@ -1,0 +1,173 @@
+"""In-process fake of compute.googleapis.com v1 for CPU-VM provisioner
+tests (sibling of fake_tpu_api.py; reference analog: the mocked-cloud
+fixtures, SURVEY.md §4).  Scriptable per-zone behavior:
+  fake.set_zone_behavior('us-central1-a', 'stockout' | 'quota' | 'ok')
+Supports instances insert/bulkInsert/get/list/delete/stop/start and
+DONE-immediately zone operations.
+"""
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict
+
+
+class _State:
+    def __init__(self):
+        self.instances: Dict[str, dict] = {}        # key: zone/name
+        self.zone_behavior: Dict[str, str] = {}
+        self.lock = threading.Lock()
+
+
+class FakeGceApi:
+    def __init__(self):
+        self.state = _State()
+        handler = self._make_handler()
+        self.server = ThreadingHTTPServer(('127.0.0.1', 0), handler)
+        self.thread = threading.Thread(target=self.server.serve_forever,
+                                       daemon=True)
+        self.thread.start()
+
+    @property
+    def endpoint(self) -> str:
+        return f'http://127.0.0.1:{self.server.server_port}/compute/v1'
+
+    def close(self):
+        self.server.shutdown()
+
+    # ----- scripting ---------------------------------------------------------
+    def set_zone_behavior(self, zone: str, behavior: str):
+        self.state.zone_behavior[zone] = behavior
+
+    def instance(self, zone: str, name: str) -> dict:
+        return self.state.instances[f'{zone}/{name}']
+
+    def set_status(self, zone: str, name: str, status: str):
+        with self.state.lock:
+            self.state.instances[f'{zone}/{name}']['status'] = status
+
+    # ----- handler -----------------------------------------------------------
+    def _make_handler(self):
+        state = self.state
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, code: int, body: dict):
+                blob = json.dumps(body).encode()
+                self.send_response(code)
+                self.send_header('Content-Type', 'application/json')
+                self.send_header('Content-Length', str(len(blob)))
+                self.end_headers()
+                self.wfile.write(blob)
+
+            def _error(self, code: int, message: str):
+                self._send(code, {'error': {'code': code,
+                                            'message': message}})
+
+            def _body(self) -> dict:
+                length = int(self.headers.get('Content-Length', 0) or 0)
+                if not length:
+                    return {}
+                return json.loads(self.rfile.read(length))
+
+            def _op(self):
+                return self._send(200, {'name': 'op-1', 'status': 'DONE'})
+
+            @staticmethod
+            def _materialize(zone: str, name: str, props: dict) -> dict:
+                inst = dict(props)
+                inst['name'] = name
+                inst['status'] = 'RUNNING'
+                # GCP assigns addresses at materialization, replacing the
+                # request's interface spec with concrete IPs.
+                inst['networkInterfaces'] = [{
+                    'networkIP': '10.0.0.1',
+                    'accessConfigs': [{'natIP': '1.2.3.4'}],
+                }]
+                state.instances[f'{zone}/{name}'] = inst
+                return inst
+
+            def do_GET(self):
+                path = self.path.split('?')[0]
+                m = re.match(r'.*/zones/([^/]+)/instances/?([^/]*)$', path)
+                if m and m.group(2):
+                    inst = state.instances.get(
+                        f'{m.group(1)}/{m.group(2)}')
+                    if inst is None:
+                        return self._error(404, 'instance not found')
+                    return self._send(200, inst)
+                if m:
+                    zone = m.group(1)
+                    items = [i for k, i in state.instances.items()
+                             if k.startswith(f'{zone}/')]
+                    return self._send(200, {'items': items})
+                if '/operations/' in path:
+                    return self._send(200, {'name': 'op-1',
+                                            'status': 'DONE'})
+                return self._error(404, f'unknown path {path}')
+
+            def do_POST(self):
+                path = self.path.split('?')[0]
+                m = re.match(r'.*/zones/([^/]+)/instances$', path)
+                if m:
+                    zone = m.group(1)
+                    behavior = state.zone_behavior.get(zone, 'ok')
+                    if behavior == 'stockout':
+                        return self._error(
+                            429, 'ZONE_RESOURCE_POOL_EXHAUSTED')
+                    if behavior == 'quota':
+                        return self._error(403, 'Quota exceeded: CPUS')
+                    body = self._body()
+                    with state.lock:
+                        self._materialize(zone, body['name'], body)
+                    return self._op()
+                m = re.match(r'.*/zones/([^/]+)/instances/bulkInsert$',
+                             path)
+                if m:
+                    zone = m.group(1)
+                    behavior = state.zone_behavior.get(zone, 'ok')
+                    if behavior == 'stockout':
+                        return self._error(
+                            429, 'ZONE_RESOURCE_POOL_EXHAUSTED')
+                    body = self._body()
+                    props = body.get('instanceProperties', {})
+                    names = list(body.get('perInstanceProperties', {}))
+                    with state.lock:
+                        for name in names:
+                            self._materialize(zone, name, props)
+                    return self._op()
+                m = re.match(
+                    r'.*/zones/([^/]+)/instances/([^/]+)/'
+                    r'(stop|start|resume)$', path)
+                if m:
+                    zone, name, verb = m.groups()
+                    inst = state.instances.get(f'{zone}/{name}')
+                    if inst is None:
+                        return self._error(404, 'instance not found')
+                    if verb == 'start' and inst['status'] not in (
+                            'TERMINATED',):
+                        return self._error(
+                            400, f'instance in {inst["status"]} is not '
+                            'in a state that allows start')
+                    with state.lock:
+                        # GCE reports stopped VMs as TERMINATED.
+                        inst['status'] = ('TERMINATED' if verb == 'stop'
+                                          else 'RUNNING')
+                    return self._op()
+                return self._error(404, f'unknown POST {path}')
+
+            def do_DELETE(self):
+                path = self.path.split('?')[0]
+                m = re.match(r'.*/zones/([^/]+)/instances/([^/]+)$', path)
+                if m:
+                    with state.lock:
+                        state.instances.pop(
+                            f'{m.group(1)}/{m.group(2)}', None)
+                    return self._op()
+                return self._error(404, f'unknown DELETE {path}')
+
+        return Handler

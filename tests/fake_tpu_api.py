@@ -123,6 +123,8 @@ class FakeTpuApi:
                             node['state'] = 'READY'
 
             def _advance_qr(self, zone: str, qr: dict):
+                if state.zone_behavior.get(zone) == 'qr_stuck':
+                    return  # queued resource parks forever in this zone
                 with state.lock:
                     if qr['state']['state'] == 'WAITING_FOR_RESOURCES':
                         qr['_polls'] = qr.get('_polls', 0) + 1

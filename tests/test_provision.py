@@ -144,6 +144,142 @@ def test_gcp_stockout_classified(fake_tpu):
         provision.run_instances('gcp', _tpu_config(cluster='c2'))
 
 
+# ----- GCP Compute Engine provisioner (fake API) -----------------------------
+@pytest.fixture
+def fake_gce(monkeypatch, fake_tpu):
+    """Fake GCE alongside the fake TPU API (the merged read paths consult
+    both services)."""
+    from tests.fake_gce_api import FakeGceApi
+    fake = FakeGceApi()
+    monkeypatch.setenv('SKYTPU_GCE_API_ENDPOINT', fake.endpoint)
+    yield fake
+    fake.close()
+
+
+def _gce_config(cluster='cpu1', zone='us-central1-a', num_nodes=1,
+                spot=False, **res):
+    res.setdefault('cpus', '4')
+    return ProvisionConfig(
+        cluster_name=cluster, num_nodes=num_nodes,
+        resources_config={'use_spot': spot,
+                          'infra': f'gcp/{zone.rsplit("-", 1)[0]}/{zone}',
+                          **res},
+        region=zone.rsplit('-', 1)[0], zone=zone)
+
+
+def test_gce_cpu_vm_lifecycle(fake_gce):
+    record = provision.run_instances('gcp', _gce_config())
+    assert record.instance_ids == ['cpu1-0']
+    provision.wait_instances('gcp', 'cpu1', zone='us-central1-a',
+                             timeout_s=30)
+    statuses = provision.query_instances('gcp', 'cpu1',
+                                         zone='us-central1-a')
+    assert statuses['cpu1-0'] is InstanceStatus.RUNNING
+    info = provision.get_cluster_info('gcp', 'cpu1', zone='us-central1-a')
+    assert info.instances[0].external_ips == ['1.2.3.4']
+    inst = fake_gce.instance('us-central1-a', 'cpu1-0')
+    # cpus='4' resolved through the catalog to a concrete machine type
+    assert 'machineTypes/' in inst['machineType']
+    assert inst['labels']['skytpu-cluster'] == 'cpu1'
+    # stop -> GCE reports TERMINATED, framework maps to STOPPED
+    provision.stop_instances('gcp', 'cpu1', zone='us-central1-a')
+    statuses = provision.query_instances('gcp', 'cpu1',
+                                         zone='us-central1-a')
+    assert statuses['cpu1-0'] is InstanceStatus.STOPPED
+    # re-run restarts in place (disk preserved)
+    record = provision.run_instances('gcp', _gce_config())
+    assert record.resumed
+    statuses = provision.query_instances('gcp', 'cpu1',
+                                         zone='us-central1-a')
+    assert statuses['cpu1-0'] is InstanceStatus.RUNNING
+    provision.terminate_instances('gcp', 'cpu1', zone='us-central1-a')
+    assert provision.query_instances('gcp', 'cpu1',
+                                     zone='us-central1-a') == {}
+
+
+def test_gce_multi_node_uses_bulk_insert(fake_gce):
+    record = provision.run_instances(
+        'gcp', _gce_config(cluster='multi', num_nodes=3))
+    assert record.instance_ids == ['multi-0', 'multi-1', 'multi-2']
+    statuses = provision.query_instances('gcp', 'multi',
+                                         zone='us-central1-a')
+    assert len(statuses) == 3
+    assert all(s is InstanceStatus.RUNNING for s in statuses.values())
+
+
+def test_gce_explicit_instance_type_and_spot(fake_gce):
+    provision.run_instances(
+        'gcp', _gce_config(cluster='spotvm', spot=True,
+                           instance_type='n2-standard-8'))
+    inst = fake_gce.instance('us-central1-a', 'spotvm-0')
+    assert inst['machineType'].endswith('n2-standard-8')
+    assert inst['scheduling']['provisioningModel'] == 'SPOT'
+
+
+def test_gce_restart_waits_out_stopping(fake_gce):
+    # The real GCE API 400s a start on a STOPPING instance (the fake does
+    # too); run_instances must wait for the stop to settle first.
+    provision.run_instances('gcp', _gce_config(cluster='stg'))
+    fake_gce.set_status('us-central1-a', 'stg-0', 'STOPPING')
+
+    import threading
+
+    def settle():
+        import time as t
+        t.sleep(0.5)
+        fake_gce.set_status('us-central1-a', 'stg-0', 'TERMINATED')
+
+    th = threading.Thread(target=settle)
+    th.start()
+    record = provision.run_instances('gcp', _gce_config(cluster='stg'))
+    th.join()
+    assert record.resumed
+    statuses = provision.query_instances('gcp', 'stg',
+                                         zone='us-central1-a')
+    assert statuses['stg-0'] is InstanceStatus.RUNNING
+
+
+def test_query_both_raises_on_transient_error(fake_gce, monkeypatch):
+    # A configured-but-failing service must surface, not read as an
+    # empty cluster (silent-success teardown would leak billed slices).
+    provision.run_instances('gcp', _gce_config(cluster='te'))
+    from skypilot_tpu.provision.gcp import instance as gcp_instance
+
+    def boom(client, zone, cluster_name):
+        raise exceptions.ProvisionError('TPU API 500')
+
+    monkeypatch.setattr(gcp_instance, '_cluster_nodes', boom)
+    with pytest.raises(exceptions.ProvisionError):
+        provision.terminate_instances('gcp', 'te', zone='us-central1-a')
+
+
+def test_gce_stockout_classified(fake_gce):
+    fake_gce.set_zone_behavior('us-central1-a', 'stockout')
+    with pytest.raises(exceptions.InsufficientCapacityError):
+        provision.run_instances('gcp', _gce_config(cluster='so'))
+    fake_gce.set_zone_behavior('us-central1-a', 'quota')
+    with pytest.raises(exceptions.QuotaExceededError):
+        provision.run_instances('gcp', _gce_config(cluster='so2'))
+
+
+def test_gce_and_tpu_clusters_coexist(fake_gce, fake_tpu):
+    # TPU and CPU clusters in the same zone stay isolated by cluster
+    # label; terminate touches only the addressed cluster.
+    provision.run_instances('gcp', _tpu_config(cluster='tpuc',
+                                               zone='us-east5-a'))
+    provision.run_instances('gcp', _gce_config(cluster='cpuc',
+                                               zone='us-east5-a'))
+    assert set(provision.query_instances(
+        'gcp', 'tpuc', zone='us-east5-a')) == {'tpuc-0'}
+    assert set(provision.query_instances(
+        'gcp', 'cpuc', zone='us-east5-a')) == {'cpuc-0'}
+    provision.terminate_instances('gcp', 'cpuc', zone='us-east5-a')
+    assert provision.query_instances('gcp', 'cpuc',
+                                     zone='us-east5-a') == {}
+    assert set(provision.query_instances(
+        'gcp', 'tpuc', zone='us-east5-a')) == {'tpuc-0'}
+
+
 # ----- failover engine -------------------------------------------------------
 def _mk_tpu_task(acc='tpu-v6e-8'):
     t = Task('train', run='echo hi')
@@ -195,3 +331,85 @@ def test_failover_exhaustion_reports_history(enable_all_clouds):
     with pytest.raises(exceptions.ResourcesUnavailableError) as err:
         failover.provision_with_retries(_mk_tpu_task(), 'c', provision_fn)
     assert 'Failover history' in str(err.value)
+
+
+def test_retry_until_up_sweeps_again(enable_all_clouds, monkeypatch):
+    # Round 1: stockout everywhere.  Round 2: capacity appeared — the
+    # stockout blocklist must have been forgotten between rounds.
+    monkeypatch.setenv('SKYTPU_RETRY_UNTIL_UP_GAP_S', '0')
+    rounds = {'n': 0, 'attempts': 0}
+
+    def provision_fn(candidate):
+        rounds['attempts'] += 1
+        if rounds['attempts'] <= 3:   # v6e-8: 3 zones per sweep
+            raise exceptions.InsufficientCapacityError('stockout')
+        from skypilot_tpu.provision.common import ProvisionRecord
+        return ProvisionRecord('gcp', 'c', candidate.region,
+                               candidate.zone, ['c-0'])
+
+    result = failover.provision_with_retries(
+        _mk_tpu_task(), 'c', provision_fn, retry_until_up=True,
+        max_rounds=3)
+    assert rounds['attempts'] == 4
+    assert result.record.zone is not None
+
+
+def test_retry_until_up_keeps_quota_blocklist(enable_all_clouds,
+                                              monkeypatch):
+    # Quota failures are permanent across rounds: a region that returned
+    # QuotaExceeded must not be retried on later sweeps.
+    monkeypatch.setenv('SKYTPU_RETRY_UNTIL_UP_GAP_S', '0')
+    seen = []
+
+    def provision_fn(candidate):
+        seen.append(candidate.region)
+        raise exceptions.QuotaExceededError('quota')
+
+    with pytest.raises(exceptions.ResourcesUnavailableError):
+        failover.provision_with_retries(
+            _mk_tpu_task('tpu-v2-8'), 'c', provision_fn,
+            retry_until_up=True, max_rounds=3)
+    # every attempted region distinct — no region retried across rounds
+    assert len(seen) == len(set(seen))
+
+
+def test_queued_resource_timeout_fails_over(fake_tpu, enable_all_clouds,
+                                            monkeypatch):
+    # Wait-vs-failover policy: a queued resource parked past
+    # queued_resource_wait_s abandons the zone; the failover engine
+    # deletes the parked QR and the next zone's QR turns ACTIVE.
+    monkeypatch.setenv('SKYTPU_QUEUED_RESOURCE_WAIT_S', '2')
+    zones_tried = []
+
+    def provision_fn(candidate):
+        zones_tried.append(candidate.zone)
+        if len(zones_tried) == 1:
+            fake_tpu.set_zone_behavior(candidate.zone, 'qr_stuck')
+        cfg = ProvisionConfig(
+            cluster_name='qrw', num_nodes=1,
+            resources_config={'accelerators': 'tpu-v6e-8',
+                              'use_spot': True,
+                              'infra': f'gcp/{candidate.region}/'
+                                       f'{candidate.zone}'},
+            region=candidate.region, zone=candidate.zone)
+        provision.run_instances('gcp', cfg)
+        provision.wait_instances('gcp', 'qrw', zone=candidate.zone,
+                                 timeout_s=30)
+        from skypilot_tpu.provision.common import ProvisionRecord
+        return ProvisionRecord('gcp', 'qrw', candidate.region,
+                               candidate.zone, ['qrw-0'])
+
+    def cleanup_fn(candidate):
+        provision.terminate_instances('gcp', 'qrw', zone=candidate.zone)
+
+    t = Task('train', run='echo hi')
+    t.set_resources(Resources.from_yaml_config(
+        {'accelerators': 'tpu-v6e-8', 'use_spot': True, 'infra': 'gcp'}))
+    result = failover.provision_with_retries(t, 'qrw', provision_fn,
+                                             cleanup_fn=cleanup_fn)
+    assert len(zones_tried) == 2
+    assert result.record.zone == zones_tried[1]
+    # the stuck zone's parked QR was cleaned up on failover
+    stuck = zones_tried[0]
+    assert all(not k.startswith(f'{stuck}/')
+               for k in fake_tpu.state.queued)
