@@ -1,21 +1,41 @@
 """Storage object model: buckets mounted/copied into clusters.
 
-Parity: sky/data/storage.py (Storage :560, AbstractStore :320, modes :128).
-GCS is the first-class store (TPU clusters live in GCP; gcsfuse is
-preinstalled on TPU VMs); S3/R2 ride the same interface via their CLIs.
+Parity: sky/data/storage.py (Storage :560, AbstractStore :320, modes
+:128, bucket lifecycle :560+).  GCS is the first-class store (TPU
+clusters live in GCP; gcsfuse is preinstalled on TPU VMs); S3/R2 ride
+the same interface via their CLIs.
+
+Hermetic boundary for tests: with SKYTPU_FAKE_GCS_ROOT set,
+`gs://bucket/...` maps to `$ROOT/bucket/...` and every operation —
+lifecycle, sync, and MOUNT (a symlink standing in for gcsfuse) — is a
+local file op.  Two local-cloud clusters that share nothing else can
+then only exchange data through the "bucket", which is exactly the
+property the managed-jobs checkpoint-recovery e2e proves.
 """
 from __future__ import annotations
 
 import dataclasses
 import enum
+import os
 import shlex
-from typing import Dict, Optional, TYPE_CHECKING
+import shutil
+import subprocess
+from typing import Dict, List, Optional, TYPE_CHECKING
 
 from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.data import storage_utils
 
 if TYPE_CHECKING:
     from skypilot_tpu.backends import tpu_vm_backend
     from skypilot_tpu.global_user_state import ClusterHandle
+
+logger = sky_logging.init_logger(__name__)
+
+
+def _fake_root() -> Optional[str]:
+    root = os.environ.get('SKYTPU_FAKE_GCS_ROOT')
+    return os.path.expanduser(root) if root else None
 
 
 class StoreType(enum.Enum):
@@ -41,21 +61,185 @@ class StorageMode(enum.Enum):
 
 @dataclasses.dataclass
 class StorageMount:
-    """One `file_mounts:` entry whose value is a storage config dict."""
+    """One `file_mounts:` entry whose value is a storage config dict.
+
+    Two shapes (reference task-YAML semantics):
+      - `source: gs://bucket[/prefix]` — mount an existing bucket;
+      - `name: my-bucket [, source: ./local_dir]` — framework-managed
+        bucket: created if missing, local source uploaded, then mounted.
+    """
     mount_path: str
-    source: str                      # gs://bucket[/prefix]
+    source: str                      # gs://bucket[/prefix] ('' if name-d)
     mode: StorageMode = StorageMode.MOUNT
     name: Optional[str] = None
 
     @classmethod
     def from_yaml_config(cls, mount_path: str,
                          config: Dict) -> 'StorageMount':
+        source = config.get('source', '')
+        name = config.get('name')
+        if not source and not name:
+            raise exceptions.StorageError(
+                f'storage mount {mount_path!r} needs "source" or "name"')
         return cls(
             mount_path=mount_path,
-            source=config.get('source', ''),
+            source=source,
             mode=StorageMode(config.get('mode', 'MOUNT').upper()),
-            name=config.get('name'),
+            name=name,
         )
+
+    def materialize(self) -> str:
+        """Ensure the backing bucket exists (creating/uploading for
+        name-managed mounts); returns the gs:// URL to mount/copy."""
+        if self.source.startswith(('gs://', 's3://', 'r2://')):
+            return self.source
+        if self.name is None:
+            raise exceptions.StorageError(
+                f'storage mount {self.mount_path!r}: a local source '
+                f'({self.source!r}) needs "name" for the bucket to '
+                'upload into')
+        local_source = self.source or None
+        Storage(self.name, source=local_source).materialize()
+        return f'gs://{self.name}'
+
+
+class GcsStore:
+    """GCS bucket lifecycle + sync (parity: sky/data/storage.py GcsStore
+    :2149 create/delete/upload).  Real path drives gsutil; with
+    SKYTPU_FAKE_GCS_ROOT every op is a local file op on
+    `$ROOT/<bucket>/` (see module docstring)."""
+
+    def __init__(self, bucket: str) -> None:
+        if '/' in bucket:
+            raise exceptions.StorageError(
+                f'bucket name may not contain "/": {bucket!r}')
+        self.bucket = bucket
+
+    @property
+    def url(self) -> str:
+        return f'gs://{self.bucket}'
+
+    def _local(self, prefix: str = '') -> str:
+        root = _fake_root()
+        assert root is not None
+        return os.path.join(root, self.bucket, prefix.lstrip('/'))
+
+    def _gsutil(self, *args: str) -> subprocess.CompletedProcess:
+        return subprocess.run(['gsutil', '-m', *args], check=False,
+                              capture_output=True, text=True)
+
+    # ----- lifecycle ---------------------------------------------------------
+    def exists(self) -> bool:
+        if _fake_root():
+            return os.path.isdir(self._local())
+        return self._gsutil('ls', '-b', self.url).returncode == 0
+
+    def create(self, region: Optional[str] = None) -> None:
+        if _fake_root():
+            os.makedirs(self._local(), exist_ok=True)
+            return
+        args = ['mb']
+        if region:
+            args += ['-l', region]
+        res = self._gsutil(*args, self.url)
+        if res.returncode != 0 and 'already' not in res.stderr.lower():
+            raise exceptions.StorageError(
+                f'failed to create {self.url}: {res.stderr.strip()}')
+
+    def delete(self) -> None:
+        if _fake_root():
+            shutil.rmtree(self._local(), ignore_errors=True)
+            return
+        res = self._gsutil('rm', '-r', self.url)
+        if res.returncode != 0 and 'bucketnotfound' not in \
+                res.stderr.lower().replace(' ', ''):
+            raise exceptions.StorageError(
+                f'failed to delete {self.url}: {res.stderr.strip()}')
+
+    # ----- data --------------------------------------------------------------
+    def sync_up(self, src_dir: str, prefix: str = '') -> None:
+        """Upload a directory, honoring `.skyignore` at its root."""
+        src_dir = os.path.expanduser(src_dir)
+        excludes = storage_utils.load_excludes(src_dir)
+        if _fake_root():
+            dst = self._local(prefix)
+            for dirpath, _dirnames, filenames in os.walk(src_dir):
+                for fname in filenames:
+                    full = os.path.join(dirpath, fname)
+                    rel = os.path.relpath(full, src_dir).replace(
+                        os.sep, '/')
+                    if storage_utils.excluded(rel, excludes):
+                        continue
+                    target = os.path.join(dst, rel)
+                    os.makedirs(os.path.dirname(target), exist_ok=True)
+                    shutil.copy2(full, target)
+            return
+        args = ['rsync', '-r']
+        if excludes:
+            # gsutil honors a single -x; OR the patterns into one regex.
+            args += ['-x', '|'.join(fnmatch_to_re(p) for p in excludes)]
+        res = self._gsutil(*args, src_dir,
+                           f'{self.url}/{prefix}'.rstrip('/'))
+        if res.returncode != 0:
+            raise exceptions.StorageError(
+                f'sync_up to {self.url} failed: {res.stderr.strip()}')
+
+    def sync_down(self, local_dir: str, prefix: str = '') -> None:
+        local_dir = os.path.expanduser(local_dir)
+        os.makedirs(local_dir, exist_ok=True)
+        if _fake_root():
+            src = self._local(prefix)
+            if os.path.isdir(src):
+                shutil.copytree(src, local_dir, dirs_exist_ok=True)
+            return
+        res = self._gsutil('rsync', '-r',
+                           f'{self.url}/{prefix}'.rstrip('/'), local_dir)
+        if res.returncode != 0:
+            raise exceptions.StorageError(
+                f'sync_down from {self.url} failed: {res.stderr.strip()}')
+
+    def list_prefix(self, prefix: str = '') -> List[str]:
+        if _fake_root():
+            base = self._local(prefix)
+            out = []
+            for dirpath, _d, filenames in os.walk(base):
+                for fname in filenames:
+                    rel = os.path.relpath(os.path.join(dirpath, fname),
+                                          self._local())
+                    out.append(rel.replace(os.sep, '/'))
+            return sorted(out)
+        res = self._gsutil('ls', '-r',
+                           f'{self.url}/{prefix}'.rstrip('/'))
+        if res.returncode != 0:
+            return []
+        marker = f'{self.url}/'
+        return sorted(line[len(marker):] for line in
+                      res.stdout.splitlines()
+                      if line.startswith(marker) and
+                      not line.endswith(('/', ':')))
+
+
+def fnmatch_to_re(pattern: str) -> str:
+    """gsutil -x takes regexes; translate a glob conservatively."""
+    import fnmatch as fnmatch_lib
+    return fnmatch_lib.translate(pattern)
+
+
+@dataclasses.dataclass
+class Storage:
+    """User-facing storage object: a (possibly framework-created) bucket
+    plus an optional local source to upload (parity: Storage :560)."""
+    name: str                                   # bucket name
+    source: Optional[str] = None                # local dir to upload
+    persistent: bool = True                     # survive `storage delete`?
+
+    def materialize(self) -> GcsStore:
+        store = GcsStore(self.name)
+        if not store.exists():
+            store.create()
+        if self.source:
+            store.sync_up(self.source)
+        return store
 
 
 def copy_command(source: str, dst: str) -> str:
@@ -63,6 +247,11 @@ def copy_command(source: str, dst: str) -> str:
     store = StoreType.from_url(source)
     q = shlex.quote
     if store is StoreType.GCS:
+        root = _fake_root()
+        if root is not None:
+            src = os.path.join(root, source[len('gs://'):])
+            return (f'mkdir -p {q(dst)} && mkdir -p {q(src)} && '
+                    f'cp -a {q(src)}/. {q(dst)}/')
         return (f'mkdir -p {q(dst)} && '
                 f'gsutil -m rsync -r {q(source)} {q(dst)}')
     if store is StoreType.S3:
@@ -74,13 +263,21 @@ def copy_command(source: str, dst: str) -> str:
 def mount_command(source: str, mount_path: str,
                   cached: bool = False) -> str:
     """FUSE mount command (parity: sky/data/mounting_utils.py; gcsfuse for
-    GCS, MOUNT_CACHED via gcsfuse file cache)."""
+    GCS, MOUNT_CACHED via gcsfuse file cache).  Under the fake-GCS
+    boundary a symlink into the fake root stands in for the FUSE mount —
+    same contract (writes land in the bucket), no FUSE needed."""
     store = StoreType.from_url(source)
     q = shlex.quote
     if store is not StoreType.GCS:
         raise exceptions.StorageError(
             f'MOUNT currently supports gs:// only, got {source}')
     bucket_and_prefix = source[len('gs://'):]
+    root = _fake_root()
+    if root is not None:
+        target = os.path.join(root, bucket_and_prefix)
+        return (f'mkdir -p {q(target)} && '
+                f'mkdir -p "$(dirname {q(mount_path)})" && '
+                f'ln -sfn {q(target)} {q(mount_path)}')
     bucket = bucket_and_prefix.split('/', 1)[0]
     flags = '--implicit-dirs'
     if cached:
@@ -105,13 +302,31 @@ def fetch_bucket_to_cluster(backend: 'tpu_vm_backend.TpuVmBackend',
 
 def mount_on_cluster(backend: 'tpu_vm_backend.TpuVmBackend',
                      handle: 'ClusterHandle', mount: StorageMount) -> None:
+    """Materialize (bucket create + source upload) then mount/copy the
+    storage onto every cluster host."""
+    url = mount.materialize()
+    mount_path = mount.mount_path
+    if handle.cloud == 'local':
+        # Local cloud: cluster-private paths live under the agent home
+        # (same translation sync_file_mounts applies).
+        mount_path = os.path.join(
+            backend._agent_home(handle),  # pylint: disable=protected-access
+            mount_path.lstrip('/~'))
     if mount.mode is StorageMode.COPY:
-        return fetch_bucket_to_cluster(backend, handle, mount.source,
-                                       mount.mount_path)
-    cmd = mount_command(mount.source, mount.mount_path,
+        return fetch_bucket_to_cluster(backend, handle, url, mount_path)
+    cmd = mount_command(url, mount_path,
                         cached=mount.mode is StorageMode.MOUNT_CACHED)
     for runner in backend._host_runners(handle):  # pylint: disable=protected-access
         rc = runner.run(cmd)
         if rc != 0:
             raise exceptions.StorageError(
-                f'mount failed on {runner.host}: {mount.source}')
+                f'mount failed on {runner.host}: {url}')
+
+
+def mount_storage_mounts(backend: 'tpu_vm_backend.TpuVmBackend',
+                         handle: 'ClusterHandle',
+                         storage_mounts: Dict[str, Dict]) -> None:
+    """Apply every `storage_mounts` entry of a task (launch stage)."""
+    for mount_path, config in (storage_mounts or {}).items():
+        mount_on_cluster(backend, handle,
+                         StorageMount.from_yaml_config(mount_path, config))

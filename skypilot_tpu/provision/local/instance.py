@@ -96,6 +96,13 @@ def terminate_instances(cluster_name: str, region=None, zone=None) -> None:
     cdir = _cluster_dir(cluster_name)
     if os.path.isdir(cdir):
         shutil.rmtree(cdir)
+    # Real VM deletion destroys the disk; the local analog is the
+    # cluster's agent home (job DB, logs, workdir, mounts).  Wiping it
+    # keeps recovery tests honest: a re-provisioned local cluster starts
+    # from nothing, and state survives only through external storage.
+    agent_home = os.path.expanduser(f'~/.skytpu/agent-{cluster_name}')
+    if os.path.isdir(agent_home):
+        shutil.rmtree(agent_home, ignore_errors=True)
 
 
 def wait_instances(cluster_name: str, region=None, zone=None,

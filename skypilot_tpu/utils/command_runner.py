@@ -54,7 +54,8 @@ class CommandRunner:
             require_outputs: bool = False):
         raise NotImplementedError
 
-    def rsync(self, source: str, target: str, up: bool = True) -> None:
+    def rsync(self, source: str, target: str, up: bool = True,
+              excludes: Optional[List[str]] = None) -> None:
         raise NotImplementedError
 
     @property
@@ -141,14 +142,18 @@ class LocalProcessRunner(CommandRunner):
             return proc.returncode, b''.join(chunks).decode(errors='replace')
         return proc.returncode
 
-    def rsync(self, source: str, target: str, up: bool = True) -> None:
+    def rsync(self, source: str, target: str, up: bool = True,
+              excludes: Optional[List[str]] = None) -> None:
         src, dst = (source, target) if up else (target, source)
         src = os.path.expanduser(src)
         dst = os.path.expanduser(dst)
         dst_dir = dst if dst.endswith('/') else os.path.dirname(dst)
         os.makedirs(dst_dir or '.', exist_ok=True)
         if _have_rsync():
-            rc = subprocess.run(['rsync', '-a', '--delete', src, dst],
+            argv = ['rsync', '-a', '--delete']
+            for pattern in excludes or []:
+                argv += ['--exclude', pattern]
+            rc = subprocess.run(argv + [src, dst],
                                 capture_output=True, check=False)
             if rc.returncode != 0:
                 raise exceptions.CommandError(rc.returncode, 'rsync',
@@ -156,12 +161,23 @@ class LocalProcessRunner(CommandRunner):
             return
         # Fallback (dev images without rsync): shutil mirror.
         import shutil
+        from skypilot_tpu.data import storage_utils
         if os.path.isdir(src):
             # trailing-slash rsync semantics: copy *contents* into dst
             src_root = src.rstrip('/')
             dst_root = (dst if src.endswith('/')
                         else os.path.join(dst, os.path.basename(src_root)))
-            shutil.copytree(src_root, dst_root, dirs_exist_ok=True)
+
+            def _ignore(dirpath, names):
+                if not excludes:
+                    return []
+                rel_base = os.path.relpath(dirpath, src_root)
+                rel_base = '' if rel_base == '.' else rel_base + '/'
+                return [n for n in names if storage_utils.excluded(
+                    (rel_base + n).replace(os.sep, '/'), excludes)]
+
+            shutil.copytree(src_root, dst_root, dirs_exist_ok=True,
+                            ignore=_ignore)
         else:
             shutil.copy2(src, dst)
 
@@ -238,13 +254,17 @@ class SSHCommandRunner(CommandRunner):
         except exceptions.CommandError:
             return False
 
-    def rsync(self, source: str, target: str, up: bool = True) -> None:
+    def rsync(self, source: str, target: str, up: bool = True,
+              excludes: Optional[List[str]] = None) -> None:
         ssh_cmd = ' '.join(self._ssh_base())
         remote = f'{self.ssh_user}@{self.ip}:{target}'
         src, dst = ((source, remote) if up else
                     (f'{self.ssh_user}@{self.ip}:{source}', target))
+        argv = ['rsync', '-a', '--delete', '-e', ssh_cmd]
+        for pattern in excludes or []:
+            argv += ['--exclude', pattern]
         rc = subprocess.run(
-            ['rsync', '-a', '--delete', '-e', ssh_cmd, src, dst],
+            argv + [src, dst],
             capture_output=True, check=False)
         if rc.returncode != 0:
             raise exceptions.CommandError(rc.returncode, 'rsync',
