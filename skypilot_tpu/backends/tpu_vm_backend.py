@@ -66,7 +66,8 @@ class TpuVmBackend(backend_lib.Backend):
                 # failover provision landing elsewhere.
                 return self._restart_locked(handle)
             return self._provision_locked(task, cluster_name,
-                                          blocked_resources)
+                                          blocked_resources,
+                                          retry_until_up=retry_until_up)
 
     def _check_reusable(self, handle: ClusterHandle,
                         task: task_lib.Task) -> bool:
@@ -100,7 +101,8 @@ class TpuVmBackend(backend_lib.Backend):
 
     def _provision_locked(self, task: task_lib.Task,
                           cluster_name: str,
-                          blocked_resources: Optional[list] = None
+                          blocked_resources: Optional[list] = None,
+                          retry_until_up: bool = False
                           ) -> ClusterHandle:
         def provision_fn(candidate: resources_lib.Resources):
             authorized_key = None
@@ -135,7 +137,8 @@ class TpuVmBackend(backend_lib.Backend):
                                             '')
         result = failover.provision_with_retries(
             task, cluster_name, provision_fn, cleanup_fn=cleanup_fn,
-            blocked_resources=blocked_resources)
+            blocked_resources=blocked_resources,
+            retry_until_up=retry_until_up)
         candidate = result.resources
         info = provision_lib.get_cluster_info(candidate.cloud, cluster_name,
                                               region=result.record.region,
@@ -189,7 +192,11 @@ class TpuVmBackend(backend_lib.Backend):
                                      os.pathsep)
             proc = subprocess.Popen(
                 [sys.executable, '-m', 'skypilot_tpu.agent.server',
-                 '--port', str(handle.agent_port)],
+                 '--port', str(handle.agent_port),
+                 '--cluster-name', handle.cluster_name,
+                 '--cloud', handle.cloud,
+                 '--region', str(handle.region),
+                 '--zone', str(handle.zone)],
                 env=env, stdout=subprocess.DEVNULL,
                 stderr=subprocess.DEVNULL,
                 start_new_session=True)
@@ -208,7 +215,10 @@ class TpuVmBackend(backend_lib.Backend):
                 'pkill -f skypilot_tpu.agent.server || true; '
                 'cd ~/skytpu_runtime && '
                 'nohup python3 -m skypilot_tpu.agent.server --port '
-                f'{handle.agent_port} > ~/.skytpu/agent.log 2>&1 &')
+                f'{handle.agent_port} '
+                f'--cluster-name {handle.cluster_name} '
+                f'--cloud {handle.cloud} --region {handle.region} '
+                f'--zone {handle.zone} > ~/.skytpu/agent.log 2>&1 &')
         client = self._agent_client(handle)
         try:
             client.wait_ready(timeout_s=60.0)
@@ -241,10 +251,12 @@ class TpuVmBackend(backend_lib.Backend):
         return _WORKDIR_DEST
 
     def sync_workdir(self, handle: ClusterHandle, workdir: str) -> None:
+        from skypilot_tpu.data import storage_utils
         src = os.path.expanduser(workdir).rstrip('/') + '/'
         dest = self._workdir_dest(handle) + '/'
+        excludes = storage_utils.load_excludes(src)
         for runner in self._host_runners(handle):
-            runner.rsync(src, dest, up=True)
+            runner.rsync(src, dest, up=True, excludes=excludes)
 
     def sync_file_mounts(self, handle: ClusterHandle,
                          file_mounts: Dict[str, str]) -> None:

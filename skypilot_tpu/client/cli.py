@@ -76,11 +76,16 @@ def _apply(options):
 @click.argument('entrypoint', nargs=-1)
 @_apply(_task_options)
 @click.option('--dryrun', is_flag=True, default=False)
-def launch(entrypoint, cluster, detach_run, dryrun, **overrides):
+@click.option('--retry-until-up', is_flag=True, default=False,
+              help='Keep sweeping placements until capacity appears '
+                   'instead of failing when every zone is exhausted.')
+def launch(entrypoint, cluster, detach_run, dryrun, retry_until_up,
+           **overrides):
     """Launch a task on a new or existing cluster."""
     task = _load_task(entrypoint, **overrides)
     cluster = cluster or f'sky-{common_utils.generate_id(length=4)}'
-    request_id = sdk.launch(task, cluster, dryrun=dryrun)
+    request_id = sdk.launch(task, cluster, dryrun=dryrun,
+                            retry_until_up=retry_until_up)
     click.echo(f'Launch request {request_id} submitted '
                f'(cluster {cluster!r}).')
     result = sdk.get(request_id)

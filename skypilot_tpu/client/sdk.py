@@ -99,11 +99,12 @@ def get(request_id: str, timeout_s: float = 3600.0) -> Any:
 
 # ----- operations ------------------------------------------------------------
 def launch(task: task_lib.Task, cluster_name: Optional[str] = None,
-           dryrun: bool = False) -> str:
+           dryrun: bool = False, retry_until_up: bool = False) -> str:
     return _post('/launch', {
         'task': task.to_yaml_config(),
         'cluster_name': cluster_name,
         'dryrun': dryrun,
+        'retry_until_up': retry_until_up,
     })['request_id']
 
 

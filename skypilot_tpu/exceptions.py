@@ -18,6 +18,10 @@ class InvalidTaskError(SkyTpuError):
     """Task YAML / construction is invalid."""
 
 
+class InvalidRequestError(SkyTpuError):
+    """API request body failed schema validation (HTTP 400)."""
+
+
 class InvalidResourcesError(SkyTpuError):
     """Resources spec is invalid (unknown accelerator, bad topology...)."""
 

@@ -43,31 +43,48 @@ def launch(
     stages: Optional[List[Stage]] = None,
     quiet_optimizer: bool = False,
     blocked_resources: Optional[list] = None,
+    retry_until_up: bool = False,
 ) -> Tuple[Optional[int], Optional[ClusterHandle]]:
     """Provision (or reuse) a cluster and run the task on it.
 
     Returns (job_id, handle).  (reference: sky/execution.py:539)
     blocked_resources: placements the failover engine must skip (used by
     managed-job recovery to avoid a zone that just preempted the task).
+    retry_until_up: keep sweeping placements until capacity appears
+    instead of failing once every zone is exhausted.
     """
     cluster_name = cluster_name or f'sky-{common_utils.generate_id()}'
     common_utils.validate_cluster_name(cluster_name)
     stages = stages or list(Stage)
     backend = TpuVmBackend()
+    from skypilot_tpu.utils import timeline
+    with timeline.Event('execution.launch', cluster=cluster_name):
+        return _launch_staged(task, cluster_name, minimize, dryrun,
+                              detach_run, stages, quiet_optimizer,
+                              blocked_resources, retry_until_up, backend)
+
+
+def _launch_staged(task, cluster_name, minimize, dryrun, detach_run,
+                   stages, quiet_optimizer, blocked_resources,
+                   retry_until_up, backend):
+    from skypilot_tpu.utils import timeline
 
     if Stage.OPTIMIZE in stages:
         existing = global_user_state.get_cluster(cluster_name)
         if existing is None or existing['status'] is not ClusterStatus.UP:
-            Optimizer.optimize(dag_lib.dag_from_task(task),
-                               minimize=minimize, quiet=quiet_optimizer)
+            with timeline.Event('stage.optimize'):
+                Optimizer.optimize(dag_lib.dag_from_task(task),
+                                   minimize=minimize, quiet=quiet_optimizer)
     if dryrun:
         logger.info('Dry run finished (plan above).')
         return None, None
 
     handle: Optional[ClusterHandle] = None
     if Stage.PROVISION in stages:
-        handle = backend.provision(task, cluster_name,
-                                   blocked_resources=blocked_resources)
+        with timeline.Event('stage.provision'):
+            handle = backend.provision(
+                task, cluster_name, blocked_resources=blocked_resources,
+                retry_until_up=retry_until_up)
     else:
         record = global_user_state.get_cluster(cluster_name)
         if record is None:
@@ -77,15 +94,25 @@ def launch(
     assert handle is not None
 
     if Stage.SYNC_WORKDIR in stages and task.workdir:
-        backend.sync_workdir(handle, task.workdir)
-    if Stage.SYNC_FILE_MOUNTS in stages and task.file_mounts:
-        backend.sync_file_mounts(handle, task.file_mounts)
+        with timeline.Event('stage.sync_workdir'):
+            backend.sync_workdir(handle, task.workdir)
+    if Stage.SYNC_FILE_MOUNTS in stages and (task.file_mounts or
+                                             task.storage_mounts):
+        with timeline.Event('stage.sync_file_mounts'):
+            if task.file_mounts:
+                backend.sync_file_mounts(handle, task.file_mounts)
+            if task.storage_mounts:
+                from skypilot_tpu.data import storage as storage_lib
+                storage_lib.mount_storage_mounts(backend, handle,
+                                                 task.storage_mounts)
     if Stage.SETUP in stages and task.setup:
-        backend.setup(handle, task)
+        with timeline.Event('stage.setup'):
+            backend.setup(handle, task)
 
     job_id: Optional[int] = None
     if Stage.EXEC in stages and task.run is not None:
-        job_id = backend.execute(handle, task, detach_run=detach_run)
+        with timeline.Event('stage.exec'):
+            job_id = backend.execute(handle, task, detach_run=detach_run)
     return job_id, handle
 
 

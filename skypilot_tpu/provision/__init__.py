@@ -9,6 +9,7 @@ import importlib
 from typing import Dict, List, Optional
 
 from skypilot_tpu import exceptions
+from skypilot_tpu.utils import timeline
 from skypilot_tpu.provision.common import (ClusterInfo, InstanceInfo,
                                            InstanceStatus, ProvisionConfig,
                                            ProvisionRecord)
@@ -34,7 +35,9 @@ def run_instances(cloud: str, config: ProvisionConfig) -> ProvisionRecord:
     """Create (or resume) the cluster's nodes.  Blocks until the creation
     request is accepted, NOT until instances are running — call
     wait_instances next."""
-    return _impl(cloud).run_instances(config)
+    with timeline.Event('provision.run_instances', cloud=cloud,
+                        cluster=config.cluster_name):
+        return _impl(cloud).run_instances(config)
 
 
 def stop_instances(cloud: str, cluster_name: str,
@@ -46,7 +49,9 @@ def stop_instances(cloud: str, cluster_name: str,
 def terminate_instances(cloud: str, cluster_name: str,
                         region: Optional[str] = None,
                         zone: Optional[str] = None) -> None:
-    return _impl(cloud).terminate_instances(cluster_name, region, zone)
+    with timeline.Event('provision.terminate_instances', cloud=cloud,
+                        cluster=cluster_name):
+        return _impl(cloud).terminate_instances(cluster_name, region, zone)
 
 
 def wait_instances(cloud: str, cluster_name: str,
@@ -54,7 +59,10 @@ def wait_instances(cloud: str, cluster_name: str,
                    zone: Optional[str] = None,
                    timeout_s: float = 1800.0) -> None:
     """Block until every node is RUNNING (raises on PREEMPTED/TERMINATED)."""
-    return _impl(cloud).wait_instances(cluster_name, region, zone, timeout_s)
+    with timeline.Event('provision.wait_instances', cloud=cloud,
+                        cluster=cluster_name):
+        return _impl(cloud).wait_instances(cluster_name, region, zone,
+                                           timeout_s)
 
 
 def query_instances(

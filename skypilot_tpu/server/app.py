@@ -3,21 +3,34 @@
 aiohttp (fastapi is not in this environment).  Mutating calls return a
 request id immediately; `GET /requests/{id}` polls; `GET /logs/...`
 streams.  Run: python -m skypilot_tpu.server.app --port 8700
+
+Hardening (parity: sky/server/server.py:216-396 auth middleware,
+requests/payloads.py validation, requests/process.py per-request
+workers):
+- bearer-token auth when SKYTPU_API_TOKEN (or api_server.auth_token in
+  config) is set — every route except /api/health;
+- jsonschema validation of every POST body (400, never a 500 KeyError);
+- LONG requests run in per-request worker processes, cancellable via
+  POST /requests/{id}/cancel.
 """
 from __future__ import annotations
 
 import asyncio
 import dataclasses
+import hmac
 import json
-from typing import Any, Dict
+import os
+from typing import Any, Dict, Optional
 
 from aiohttp import web
 
 from skypilot_tpu import core
+from skypilot_tpu import exceptions
 from skypilot_tpu import global_user_state
 from skypilot_tpu import execution
 from skypilot_tpu import sky_logging
 from skypilot_tpu import task as task_lib
+from skypilot_tpu.server import payloads
 from skypilot_tpu.server import requests_db
 from skypilot_tpu.server.executor import RequestExecutor
 
@@ -32,8 +45,55 @@ def _record_json(record: Dict[str, Any]) -> Dict[str, Any]:
     return out
 
 
+def _auth_token() -> Optional[str]:
+    token = os.environ.get('SKYTPU_API_TOKEN')
+    if token:
+        return token
+    from skypilot_tpu import sky_config
+    return sky_config.get_nested(('api_server', 'auth_token'), None)
+
+
+async def _json_body(request, schema_name: str) -> Dict[str, Any]:
+    try:
+        body = await request.json()
+    except Exception as e:  # pylint: disable=broad-except
+        raise exceptions.InvalidRequestError(
+            f'request body is not valid JSON: {e}') from e
+    payloads.validate(schema_name, body)
+    return body
+
+
+@web.middleware
+async def _error_middleware(request, handler):
+    """400 for invalid payloads, JSON (not HTML) for unhandled errors."""
+    try:
+        return await handler(request)
+    except web.HTTPException:
+        raise
+    except exceptions.InvalidRequestError as e:
+        return web.json_response({'error': str(e)}, status=400)
+    except exceptions.InvalidTaskError as e:
+        return web.json_response({'error': str(e)}, status=400)
+    except Exception as e:  # pylint: disable=broad-except
+        logger.exception(f'unhandled error on {request.path}')
+        return web.json_response(
+            {'error': f'{type(e).__name__}: {e}'}, status=500)
+
+
+@web.middleware
+async def _auth_middleware(request, handler):
+    token = _auth_token()
+    if token and request.path != '/api/health':
+        header = request.headers.get('Authorization', '')
+        supplied = header[7:] if header.startswith('Bearer ') else ''
+        if not hmac.compare_digest(supplied, token):
+            return web.json_response({'error': 'unauthorized'}, status=401)
+    return await handler(request)
+
+
 def make_app() -> web.Application:
-    app = web.Application()
+    app = web.Application(middlewares=[_auth_middleware,
+                                       _error_middleware])
     executor = RequestExecutor()
     app['executor'] = executor
 
@@ -60,6 +120,11 @@ def make_app() -> web.Application:
         return web.json_response({'status': 'healthy',
                                   'api_version': API_VERSION})
 
+    async def metrics_route(request):
+        from skypilot_tpu.server import metrics as metrics_lib
+        return web.Response(text=metrics_lib.render(),
+                            content_type='text/plain')
+
     # ----- requests ----------------------------------------------------------
     async def get_request(request):
         rec = requests_db.get(request.match_info['request_id'])
@@ -79,36 +144,29 @@ def make_app() -> web.Application:
         return web.json_response(out, dumps=lambda o: json.dumps(
             o, default=str))
 
-    # ----- cluster lifecycle -------------------------------------------------
+    # ----- cluster lifecycle (per-request worker processes) ------------------
     async def launch(request):
-        body = await request.json()
-        task = task_lib.Task.from_yaml_config(body['task'])
-        cluster_name = body.get('cluster_name')
-
-        def work():
-            job_id, handle = execution.launch(
-                task, cluster_name, detach_run=True, quiet_optimizer=True,
-                dryrun=body.get('dryrun', False))
-            return {
-                'job_id': job_id,
-                'cluster_name': handle.cluster_name if handle else None,
-            }
-
-        request_id = request.app['executor'].submit('launch', body, work)
+        body = await _json_body(request, 'launch')
+        # Validate task construction inline: a bad task is a 400 now, not
+        # a FAILED request discovered at poll time.
+        task_lib.Task.from_yaml_config(body['task'])
+        request_id = request.app['executor'].submit_process('launch', body)
         return web.json_response({'request_id': request_id})
 
     async def exec_(request):
-        body = await request.json()
-        task = task_lib.Task.from_yaml_config(body['task'])
-        cluster_name = body['cluster_name']
-
-        def work():
-            job_id, handle = execution.exec_(task, cluster_name,
-                                             detach_run=True)
-            return {'job_id': job_id, 'cluster_name': handle.cluster_name}
-
-        request_id = request.app['executor'].submit('exec', body, work)
+        body = await _json_body(request, 'exec')
+        task_lib.Task.from_yaml_config(body['task'])
+        request_id = request.app['executor'].submit_process('exec', body)
         return web.json_response({'request_id': request_id})
+
+    async def cancel_request(request):
+        ok = request.app['executor'].cancel(
+            request.match_info['request_id'])
+        if not ok:
+            return web.json_response(
+                {'error': 'request not found or already finished'},
+                status=409)
+        return web.json_response({'cancelled': True})
 
     async def status(request):
         names = request.query.getall('cluster', []) or None
@@ -117,23 +175,26 @@ def make_app() -> web.Application:
             None, lambda: core.status(names, refresh=refresh))
         return web.json_response([_record_json(r) for r in records])
 
-    def _cluster_op(name: str, fn, long: bool = True):
+    def _process_op(name: str):
         async def handler(request):
-            body = await request.json()
-            cluster = body['cluster_name']
-            request_id = request.app['executor'].submit(
-                name, body, lambda: fn(body, cluster), long=long)
+            body = await _json_body(request, 'cluster_op')
+            request_id = request.app['executor'].submit_process(name, body)
             return web.json_response({'request_id': request_id})
         return handler
 
-    down = _cluster_op('down', lambda b, c: core.down(c))
-    stop = _cluster_op('stop', lambda b, c: core.stop(c))
-    start = _cluster_op('start', lambda b, c: core.start(c))
-    autostop = _cluster_op(
-        'autostop',
-        lambda b, c: core.autostop(c, int(b.get('idle_minutes', 5)),
-                                   bool(b.get('down', False))),
-        long=False)
+    down = _process_op('down')
+    stop = _process_op('stop')
+    start = _process_op('start')
+
+    async def autostop(request):
+        body = await _json_body(request, 'autostop')
+        cluster = body['cluster_name']
+        request_id = request.app['executor'].submit(
+            'autostop', body,
+            lambda: core.autostop(cluster, int(body.get('idle_minutes', 5)),
+                                  bool(body.get('down', False))),
+            long=False)
+        return web.json_response({'request_id': request_id})
 
     async def queue(request):
         cluster = request.match_info['cluster_name']
@@ -142,7 +203,7 @@ def make_app() -> web.Application:
         return web.json_response(jobs)
 
     async def cancel(request):
-        body = await request.json()
+        body = await _json_body(request, 'cancel')
         cluster = body['cluster_name']
         job_id = int(body['job_id'])
         ok = await asyncio.get_event_loop().run_in_executor(
@@ -197,7 +258,7 @@ def make_app() -> web.Application:
 
     # ----- managed jobs (controllers run consolidated in this process) -------
     async def jobs_launch(request):
-        body = await request.json()
+        body = await _json_body(request, 'jobs_launch')
         task = task_lib.Task.from_yaml_config(body['task'])
         name = body.get('name')
 
@@ -222,7 +283,7 @@ def make_app() -> web.Application:
             o, default=str))
 
     async def jobs_cancel(request):
-        body = await request.json()
+        body = await _json_body(request, 'jobs_cancel')
         from skypilot_tpu import jobs as jobs_lib
         job_id = int(body['job_id'])
         ok = await asyncio.get_event_loop().run_in_executor(
@@ -259,7 +320,7 @@ def make_app() -> web.Application:
 
     # ----- serve (controllers run consolidated in this process) --------------
     async def serve_up(request):
-        body = await request.json()
+        body = await _json_body(request, 'serve_up')
         task = task_lib.Task.from_yaml_config(body['task'])
         name = body.get('name')
 
@@ -272,7 +333,7 @@ def make_app() -> web.Application:
         return web.json_response({'request_id': request_id})
 
     async def serve_down(request):
-        body = await request.json()
+        body = await _json_body(request, 'serve_down')
         name = body['name']
         purge = bool(body.get('purge', False))
 
@@ -345,7 +406,9 @@ def make_app() -> web.Application:
         return web.json_response(out)
 
     app.router.add_get('/api/health', health)
+    app.router.add_get('/metrics', metrics_route)
     app.router.add_get('/requests/{request_id}', get_request)
+    app.router.add_post('/requests/{request_id}/cancel', cancel_request)
     app.router.add_get('/requests', list_requests)
     app.router.add_post('/launch', launch)
     app.router.add_post('/exec', exec_)

@@ -1,18 +1,30 @@
 """Request executor: LONG vs SHORT pools (parity:
 sky/server/requests/executor.py:1-20 design note).
 
-LONG requests (launch/provision/down — minutes, hold cluster locks) and
-SHORT requests (status/queue/cancel — sub-second) get separate thread
-pools so a slow provision never starves `status`.  Results/errors persist
-to the requests DB; the HTTP layer returns request ids immediately.
+LONG requests (launch/provision/down — minutes, hold cluster locks) run
+each in their OWN worker process (reference: per-request processes,
+sky/server/requests/process.py:16): a hung provision can be killed via
+`POST /requests/{id}/cancel` without poisoning a pool, and worker death
+releases its OS file locks.  A bounded thread pool launches/joins the
+processes, so LONG concurrency stays capped and excess requests queue.
+
+SHORT requests (status/queue/cancel — sub-second) stay on a thread pool;
+they are not cancellable (nothing to kill that won't finish first).
+
+Results/errors persist to the requests DB; the HTTP layer returns request
+ids immediately.
 """
 from __future__ import annotations
 
 import concurrent.futures
+import multiprocessing
+import threading
+import time
 import traceback
-from typing import Any, Callable, Dict
+from typing import Any, Callable, Dict, Optional
 
 from skypilot_tpu import sky_logging
+from skypilot_tpu.server import metrics
 from skypilot_tpu.server import requests_db
 from skypilot_tpu.server.requests_db import RequestStatus
 
@@ -21,6 +33,13 @@ logger = sky_logging.init_logger(__name__)
 _LONG_WORKERS = 4
 _SHORT_WORKERS = 16
 
+# 'spawn', not 'fork': the server is a threaded process (event loop +
+# consolidated controllers), and a forked child would inherit open sqlite
+# connections and possibly mid-acquire locks.  Spawn costs ~2s of
+# interpreter startup per request — noise against minutes-long
+# provisions, and the child starts from a clean slate.
+_MP_CTX = multiprocessing.get_context('spawn')
+
 
 class RequestExecutor:
     def __init__(self) -> None:
@@ -28,7 +47,78 @@ class RequestExecutor:
             _LONG_WORKERS, thread_name_prefix='skytpu-long')
         self._short = concurrent.futures.ThreadPoolExecutor(
             _SHORT_WORKERS, thread_name_prefix='skytpu-short')
+        self._procs: Dict[str, multiprocessing.Process] = {}
+        self._lock = threading.Lock()
 
+    # ----- LONG: per-request worker process ----------------------------------
+    def submit_process(self, name: str, body: Dict[str, Any]) -> str:
+        """Run a named handler (server/handlers.py) in its own process."""
+        from skypilot_tpu.server import handlers
+        assert name in handlers.HANDLERS, name
+        request_id = requests_db.create(name, body, 'long')
+
+        def supervise():
+            rec = requests_db.get(request_id)
+            if rec is not None and rec['status'] is RequestStatus.CANCELLED:
+                return   # cancelled while queued
+            proc = _MP_CTX.Process(
+                target=handlers.run_request,
+                args=(request_id, name, body),
+                name=f'skytpu-req-{request_id}', daemon=False)
+            with self._lock:
+                self._procs[request_id] = proc
+            t0 = time.perf_counter()
+            metrics.add_gauge('skytpu_requests_in_flight', 1, kind='long')
+            proc.start()
+            # Close the cancel race: a cancel landing between the queued
+            # check above and start() found no live process to kill —
+            # re-check now that the process is registered and running.
+            rec2 = requests_db.get(request_id)
+            if rec2 is not None and \
+                    rec2['status'] is RequestStatus.CANCELLED:
+                proc.terminate()
+            try:
+                proc.join()
+                if proc.exitcode not in (0, None):
+                    # Killed (cancel) or crashed before writing a result;
+                    # the guarded UPDATE is a no-op if a status landed.
+                    requests_db.set_status(
+                        request_id, RequestStatus.FAILED,
+                        error=f'worker exited with code {proc.exitcode}')
+            finally:
+                with self._lock:
+                    self._procs.pop(request_id, None)
+                metrics.add_gauge('skytpu_requests_in_flight', -1,
+                                  kind='long')
+                final = requests_db.get(request_id)
+                status = (final['status'].value if final else 'UNKNOWN')
+                metrics.inc_counter('skytpu_requests_total', name=name,
+                                    status=status)
+                metrics.observe('skytpu_request_duration_seconds',
+                                time.perf_counter() - t0, name=name)
+
+        self._long.submit(supervise)
+        return request_id
+
+    def cancel(self, request_id: str) -> bool:
+        """Cancel a queued or in-flight LONG request.  Returns True if
+        the request was cancelled (or already terminal -> False)."""
+        rec = requests_db.get(request_id)
+        if rec is None or rec['status'].is_terminal():
+            return False
+        # Mark first (sticky terminal), then kill any live worker.
+        requests_db.set_status(request_id, RequestStatus.CANCELLED,
+                               error='cancelled by user')
+        with self._lock:
+            proc = self._procs.get(request_id)
+        if proc is not None and proc.is_alive():
+            proc.terminate()
+            proc.join(timeout=5)
+            if proc.is_alive():
+                proc.kill()
+        return True
+
+    # ----- SHORT (and consolidated controllers): thread pool -----------------
     def submit(self, name: str, body: Dict[str, Any],
                fn: Callable[[], Any], long: bool = True) -> str:
         request_id = requests_db.create(name, body,
@@ -37,20 +127,37 @@ class RequestExecutor:
 
         def work():
             requests_db.set_status(request_id, RequestStatus.RUNNING)
+            t0 = time.perf_counter()
+            kind = 'long' if long else 'short'
+            metrics.add_gauge('skytpu_requests_in_flight', 1, kind=kind)
+            status = 'SUCCEEDED'
             try:
                 result = fn()
                 requests_db.set_status(request_id, RequestStatus.SUCCEEDED,
                                        result=result)
             except Exception as e:  # pylint: disable=broad-except
+                status = 'FAILED'
                 logger.warning(f'request {name}/{request_id} failed: {e}')
                 requests_db.set_status(
                     request_id, RequestStatus.FAILED,
                     error=f'{type(e).__name__}: {e}\n'
                           f'{traceback.format_exc()}')
+            finally:
+                metrics.add_gauge('skytpu_requests_in_flight', -1,
+                                  kind=kind)
+                metrics.inc_counter('skytpu_requests_total', name=name,
+                                    status=status)
+                metrics.observe('skytpu_request_duration_seconds',
+                                time.perf_counter() - t0, name=name)
 
         pool.submit(work)
         return request_id
 
     def shutdown(self) -> None:
+        with self._lock:
+            procs = list(self._procs.values())
+        for proc in procs:
+            if proc.is_alive():
+                proc.terminate()
         self._long.shutdown(wait=False, cancel_futures=True)
         self._short.shutdown(wait=False, cancel_futures=True)

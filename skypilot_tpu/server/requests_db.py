@@ -46,6 +46,9 @@ _DDL = [
         error TEXT,
         schedule_type TEXT
     )""",
+    # Worker-process pid (NULL for thread-executed SHORT requests);
+    # lets /requests/{id}/cancel address the right process.
+    'ALTER TABLE requests ADD COLUMN pid INTEGER',
 ]
 
 
@@ -68,7 +71,8 @@ def create(name: str, body: Dict[str, Any],
 
 
 def set_status(request_id: str, status: RequestStatus,
-               result: Any = None, error: Optional[str] = None) -> None:
+               result: Any = None, error: Optional[str] = None,
+               pid: Optional[int] = None) -> None:
     sets = ['status=?']
     params: list = [status.value]
     if status.is_terminal():
@@ -80,9 +84,19 @@ def set_status(request_id: str, status: RequestStatus,
     if error is not None:
         sets.append('error=?')
         params.append(error)
+    if pid is not None:
+        sets.append('pid=?')
+        params.append(pid)
     params.append(request_id)
+    # Terminal results are sticky: a worker's SUCCEEDED/FAILED landing
+    # just after a cancel must not overwrite CANCELLED, and vice versa
+    # (single guarded UPDATE, no check-then-write window).
+    where = 'WHERE request_id=? AND status NOT IN (?,?,?)'
+    params.extend([RequestStatus.SUCCEEDED.value,
+                   RequestStatus.FAILED.value,
+                   RequestStatus.CANCELLED.value])
     db_utils.execute(_ensure(), f'UPDATE requests SET {", ".join(sets)} '
-                     'WHERE request_id=?', tuple(params))
+                     + where, tuple(params))
 
 
 def get(request_id: str) -> Optional[Dict[str, Any]]:
@@ -100,6 +114,7 @@ def get(request_id: str) -> Optional[Dict[str, Any]]:
         'body': json.loads(row['body'] or '{}'),
         'result': json.loads(row['result']) if row['result'] else None,
         'error': row['error'],
+        'pid': row['pid'],
     }
 
 

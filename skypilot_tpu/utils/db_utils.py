@@ -61,7 +61,15 @@ def query_one(path: str, sql: str,
 def ensure_schema(path: str, ddl: List[str]) -> None:
     with transaction(path) as conn:
         for stmt in ddl:
-            conn.execute(stmt)
+            try:
+                conn.execute(stmt)
+            except sqlite3.OperationalError as e:
+                # Idempotent migrations: ADD COLUMN re-runs on every
+                # startup; an already-present column is success.
+                if 'ADD COLUMN' in stmt.upper() and \
+                        'duplicate column' in str(e).lower():
+                    continue
+                raise
 
 
 def reset_connections_for_tests() -> None:

@@ -136,6 +136,85 @@ def test_cli_entrypoints(api_server, tmp_path):
     assert result.exit_code == 0, result.output
 
 
+def test_payload_validation_400(api_server):
+    # Garbage bodies are 400s with a message, never 500 KeyErrors.
+    import requests
+    r = requests.post(f'{api_server}/launch', data='not json')
+    assert r.status_code == 400
+    assert 'JSON' in r.json()['error']
+    r = requests.post(f'{api_server}/launch', json={'bogus': 1})
+    assert r.status_code == 400
+    assert 'task' in r.json()['error']
+    r = requests.post(f'{api_server}/down', json={})
+    assert r.status_code == 400
+    r = requests.post(f'{api_server}/cancel',
+                      json={'cluster_name': 'c', 'job_id': 'NaN'})
+    assert r.status_code == 400
+
+
+def test_bearer_auth(tmp_home, enable_all_clouds, monkeypatch):
+    monkeypatch.setenv('SKYTPU_API_TOKEN', 'sekrit')
+    import asyncio
+    from aiohttp.test_utils import TestClient, TestServer
+    from skypilot_tpu.server.app import make_app
+
+    async def drive():
+        client = TestClient(TestServer(make_app()))
+        await client.start_server()
+        try:
+            r = await client.get('/api/health')      # exempt
+            assert r.status == 200
+            r = await client.get('/status')
+            assert r.status == 401
+            r = await client.get('/status', headers={
+                'Authorization': 'Bearer wrong'})
+            assert r.status == 401
+            r = await client.get('/status', headers={
+                'Authorization': 'Bearer sekrit'})
+            assert r.status == 200
+        finally:
+            await client.close()
+
+    asyncio.new_event_loop().run_until_complete(drive())
+
+
+def test_request_cancellation(api_server):
+    # A hung LONG request (stuck provision analog) is killed by
+    # POST /requests/{id}/cancel and its worker slot freed.
+    import requests
+    from skypilot_tpu.task import Task
+    from skypilot_tpu.resources import Resources
+    t = Task('hang', run='echo hi')
+    t.setup = 'sleep 600'       # wedges the worker mid-setup
+    t.set_resources(Resources.from_yaml_config({'infra': 'local'}))
+    r = requests.post(f'{api_server}/launch',
+                      json={'task': t.to_yaml_config(),
+                            'cluster_name': 'hangc'})
+    request_id = r.json()['request_id']
+    # Wait for the worker process to pick it up.
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        rec = requests.get(f'{api_server}/requests/{request_id}').json()
+        if rec['status'] == 'RUNNING':
+            break
+        time.sleep(0.5)
+    assert rec['status'] == 'RUNNING', rec
+    r = requests.post(f'{api_server}/requests/{request_id}/cancel')
+    assert r.status_code == 200
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        rec = requests.get(f'{api_server}/requests/{request_id}').json()
+        if rec['status'] == 'CANCELLED':
+            break
+        time.sleep(0.3)
+    assert rec['status'] == 'CANCELLED'
+    # cancelling a finished request is a 409
+    r = requests.post(f'{api_server}/requests/{request_id}/cancel')
+    assert r.status_code == 409
+    # cleanup: the half-provisioned local cluster may exist; down it
+    requests.post(f'{api_server}/down', json={'cluster_name': 'hangc'})
+
+
 def test_managed_jobs_over_rest(api_server, monkeypatch):
     """jobs launch -> queue -> logs -> terminal SUCCEEDED, all via REST.
 

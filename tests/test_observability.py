@@ -1,0 +1,102 @@
+"""Timeline tracing + Prometheus metrics (parity:
+sky/utils/timeline.py:85, sky/server/metrics.py)."""
+import json
+
+import pytest
+
+from skypilot_tpu.server import metrics
+from skypilot_tpu.utils import timeline
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    timeline.reset_for_tests()
+    metrics.reset_for_tests()
+    yield
+    timeline.reset_for_tests()
+    metrics.reset_for_tests()
+
+
+def test_timeline_records_launch_stages(tmp_home, enable_all_clouds,
+                                        monkeypatch, tmp_path):
+    trace = tmp_path / 'trace.json'
+    monkeypatch.setenv('SKYTPU_TIMELINE_FILE', str(trace))
+    from skypilot_tpu import core, execution
+    from skypilot_tpu.resources import Resources
+    from skypilot_tpu.task import Task
+    t = Task('tl', run='echo hi')
+    t.set_resources(Resources.from_yaml_config({'infra': 'local'}))
+    execution.launch(t, 'tl-c', quiet_optimizer=True)
+    core.down('tl-c')
+    path = timeline.dump()
+    data = json.loads(open(path).read())
+    names = {e['name'] for e in data['traceEvents']}
+    assert {'execution.launch', 'stage.optimize', 'stage.provision',
+            'provision.run_instances', 'provision.wait_instances',
+            'stage.exec', 'failover.attempt',
+            'provision.terminate_instances'} <= names
+    # B/E pairs balance per name
+    for name in names:
+        evs = [e['ph'] for e in data['traceEvents'] if e['name'] == name]
+        assert evs.count('B') == evs.count('E')
+
+
+def test_timeline_disabled_is_free(monkeypatch):
+    monkeypatch.delenv('SKYTPU_TIMELINE_FILE', raising=False)
+
+    @timeline.event('x')
+    def fn():
+        return 42
+
+    assert fn() == 42
+    assert timeline.dump() is None
+
+
+def test_metrics_render_prometheus_format():
+    metrics.inc_counter('skytpu_requests_total', name='launch',
+                        status='SUCCEEDED')
+    metrics.inc_counter('skytpu_requests_total', name='launch',
+                        status='SUCCEEDED')
+    metrics.add_gauge('skytpu_requests_in_flight', 1, kind='long')
+    metrics.observe('skytpu_request_duration_seconds', 1.5, name='launch')
+    out = metrics.render()
+    assert ('skytpu_requests_total{name="launch",status="SUCCEEDED"} 2.0'
+            in out)
+    assert 'skytpu_requests_in_flight{kind="long"} 1' in out
+    assert ('skytpu_request_duration_seconds_count{name="launch"} 1'
+            in out)
+    assert 'skytpu_request_duration_seconds_sum{name="launch"} 1.5' in out
+    assert '# TYPE skytpu_requests_total counter' in out
+
+
+def test_metrics_endpoint_and_request_instrumentation(
+        tmp_home, enable_all_clouds):
+    import asyncio
+    from aiohttp.test_utils import TestClient, TestServer
+    from skypilot_tpu.server.app import make_app
+
+    async def drive():
+        client = TestClient(TestServer(make_app()))
+        await client.start_server()
+        try:
+            # short request through the executor -> counted
+            r = await client.post('/autostop',
+                                  json={'cluster_name': 'nope',
+                                        'idle_minutes': 1})
+            assert r.status == 200
+            rid = (await r.json())['request_id']
+            for _ in range(50):
+                rr = await client.get(f'/requests/{rid}')
+                if (await rr.json())['status'] in ('SUCCEEDED', 'FAILED'):
+                    break
+                await asyncio.sleep(0.1)
+            r = await client.get('/metrics')
+            assert r.status == 200
+            text = await r.text()
+            assert 'skytpu_requests_total' in text
+            assert 'name="autostop"' in text
+            assert 'skytpu_server_start_time_seconds' in text
+        finally:
+            await client.close()
+
+    asyncio.new_event_loop().run_until_complete(drive())
