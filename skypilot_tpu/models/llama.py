@@ -47,6 +47,12 @@ class LlamaConfig:
     # attention stopped being the memory hog).
     remat_policy: str = 'none'         # 'none' | 'dots'
     attention_impl: str = 'flash'      # 'flash' | 'xla' | 'ring'
+    # MoE: n_experts > 0 swaps every block's MLP for a top-k
+    # mixture-of-experts (models/moe.py); experts shard over the mesh's
+    # 'expert' axis (Mixtral-family shape).
+    n_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
 
     @property
     def head_dim(self) -> int:
@@ -60,9 +66,13 @@ class LlamaConfig:
 
     def num_params(self) -> int:
         d, f = self.dim, self.ffn_dim
+        if self.n_experts > 0:
+            ffn = self.n_experts * 3 * d * f + d * self.n_experts  # +router
+        else:
+            ffn = 3 * d * f                          # gate, up, down
         per_layer = (d * d * 2                       # q, o proj
                      + 2 * d * (self.n_kv_heads * self.head_dim)  # k, v
-                     + 3 * d * f                     # gate, up, down
+                     + ffn
                      + 2 * d)                        # norms
         embed = self.vocab_size * d * (1 if self.tie_embeddings else 2)
         return self.n_layers * per_layer + embed + d
@@ -130,10 +140,12 @@ def _constrain_activations(x: jax.Array, mesh: Optional[Mesh],
         seq_axis = 'fsdp' if x.shape[1] % max(d_fsdp, 1) == 0 else None
         spec = P(batch_axes, seq_axis, *([None] * (x.ndim - 2)))
     else:
-        divisor = max(d_data * d_fsdp, 1)
+        d_expert = mesh.shape.get('expert', 1)
+        divisor = max(d_data * d_fsdp * d_expert, 1)
         if x.shape[0] % divisor != 0:
             return x
-        spec = P(('data', 'fsdp'), *([None] * (x.ndim - 1)))
+        spec = P(('data', 'fsdp', 'expert'),
+                 *([None] * (x.ndim - 1)))
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
 
@@ -297,7 +309,16 @@ class Block(nn.Module):
         x = x + Attention(cfg, self.mesh, name='attn')(
             RMSNorm(cfg.norm_eps, cfg.dtype, cfg.param_dtype,
                     name='attn_norm')(x), positions, decode)
-        x = x + MLP(cfg, name='mlp')(
+        if cfg.n_experts > 0:
+            from skypilot_tpu.models.moe import MoEMLP
+            mlp = MoEMLP(dim=cfg.dim, ffn_dim=cfg.ffn_dim,
+                         n_experts=cfg.n_experts, top_k=cfg.moe_top_k,
+                         capacity_factor=cfg.moe_capacity_factor,
+                         dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                         mesh=self.mesh, name='moe_mlp')
+        else:
+            mlp = MLP(cfg, name='mlp')
+        x = x + mlp(
             RMSNorm(cfg.norm_eps, cfg.dtype, cfg.param_dtype,
                     name='mlp_norm')(x))
         return _constrain_activations(x, self.mesh, cp)

@@ -1,0 +1,162 @@
+"""Mixture-of-Experts MLP with expert parallelism.
+
+The reference covers MoE only through serving recipes (llm/mixtral/,
+llm/dbrx/ — vLLM handles expert parallel internally, SURVEY.md §2.15);
+here it is a first-party layer, built the TPU way:
+
+- GShard-style top-k routing with a fixed per-expert capacity, expressed
+  as dense one-hot dispatch/combine einsums — static shapes, no sorting,
+  no dynamic gathers, so XLA tiles everything onto the MXU;
+- expert weights carry the logical 'expert' axis; with the default
+  sharding rules that maps to the `expert` mesh axis, and since tokens
+  are batch-sharded over the same axis, pjit lowers the dispatch/combine
+  contractions into all_to_alls over ICI — expert parallelism is a
+  sharding-rule change, not a model change;
+- the load-balancing auxiliary loss (mean router prob x mean token
+  fraction per expert, scaled by E) is sown under
+  `intermediates/moe_aux_loss` for the train loss to pick up.
+
+Tokens overflowing an expert's capacity are dropped for that expert (the
+residual connection around the block carries them unchanged) — standard
+Switch/GShard semantics.
+
+Recommended mesh: EP x DP (x TP), i.e. `plan_mesh(n, expert=E, data=...)`
+with fsdp=1.  Pairing expert parallelism with ZeRO-sharded dense params
+(fsdp > 1) currently makes XLA bounce the residual's backward through a
+full repartition (replicate-then-shard) — correct but slow; keep the
+dense params expert-axis-replicated instead.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def top_k_dispatch(probs: jax.Array, top_k: int, capacity: int):
+    """GShard top-k routing.
+
+    probs [B, S, E] (f32) -> (dispatch [B,S,E,C] 0/1, combine [B,S,E,C]).
+    Selection is greedy per token (k rounds of argmax); capacity slots
+    fill in (round, token) order; selected gates renormalize to sum 1.
+    """
+    b, s, e = probs.shape
+    masks = []
+    p = probs
+    for _ in range(top_k):
+        idx = jnp.argmax(p, axis=-1)                       # [B, S]
+        mask = jax.nn.one_hot(idx, e, dtype=probs.dtype)   # [B, S, E]
+        masks.append(mask)
+        p = p * (1.0 - mask)
+    gate_sum = sum((probs * m).sum(-1) for m in masks)     # [B, S]
+    gate_sum = jnp.maximum(gate_sum, 1e-9)
+
+    dispatch = jnp.zeros((b, s, e, capacity), probs.dtype)
+    combine = jnp.zeros((b, s, e, capacity), probs.dtype)
+    counts = jnp.zeros((b, 1, e), probs.dtype)             # slots used
+    for mask in masks:
+        pos = jnp.cumsum(mask, axis=1) - mask + counts     # [B, S, E]
+        counts = counts + jnp.sum(mask, axis=1, keepdims=True)
+        keep = mask * (pos < capacity)
+        pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                                dtype=probs.dtype) * keep[..., None]
+        gate = (probs * mask).sum(-1) / gate_sum           # [B, S]
+        dispatch = dispatch + pos_oh
+        combine = combine + pos_oh * gate[..., None, None]
+    return dispatch, combine
+
+
+def load_balancing_loss(probs: jax.Array, dispatch: jax.Array) -> jax.Array:
+    """Switch-style aux loss: E * mean_prob_e . mean_assigned_frac_e."""
+    e = probs.shape[-1]
+    mean_prob = probs.mean(axis=(0, 1))                    # [E]
+    assigned = dispatch.sum(-1).mean(axis=(0, 1))          # [E] (0/1 sums)
+    return e * jnp.sum(mean_prob * assigned)
+
+
+class MoEMLP(nn.Module):
+    """Drop-in replacement for a dense (SwiGLU) MLP block."""
+    dim: int
+    ffn_dim: int
+    n_experts: int
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    mesh: Optional[Mesh] = None
+
+    def _constrain(self, t: jax.Array, *axes) -> jax.Array:
+        """Pin the expert-parallel layout of internal activations so XLA
+        inserts all_to_alls instead of bouncing through a full
+        replicate-then-repartition."""
+        if self.mesh is None:
+            return t
+        sizes = {
+            'expert': self.mesh.shape.get('expert', 1),
+            ('data', 'fsdp'): (self.mesh.shape.get('data', 1) *
+                               self.mesh.shape.get('fsdp', 1)),
+        }
+        for dim_idx, axis in enumerate(axes):
+            need = sizes.get(axis)
+            if need and t.shape[dim_idx] % need:
+                return t    # tiny-shape fallback: skip the constraint
+        return jax.lax.with_sharding_constraint(
+            t, NamedSharding(self.mesh, P(*axes)))
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:          # [B, S, D]
+        b, s, d = x.shape
+        e = self.n_experts
+        capacity = max(1, int(self.capacity_factor * s * self.top_k / e))
+
+        # Router in f32: tiny compute, and routing decisions are the one
+        # place bf16 noise visibly changes the computation graph.
+        logits = nn.Dense(
+            e, use_bias=False, dtype=jnp.float32,
+            param_dtype=self.param_dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ('embed', None)),
+            name='router')(x.astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)             # [B, S, E]
+        dispatch, combine = top_k_dispatch(probs, self.top_k, capacity)
+        self.sow('intermediates', 'moe_aux_loss',
+                 load_balancing_loss(probs, dispatch))
+
+        def expert_param(name, shape, logical):
+            return self.param(
+                name, nn.with_logical_partitioning(
+                    nn.initializers.lecun_normal(), logical),
+                shape, self.param_dtype).astype(self.dtype)
+
+        # Expert weights shard over 'expert' (+'mlp'->tensor); the embed
+        # dim stays unsharded — the E-way expert split already distributes
+        # the params, and fsdp-sharding the contraction dim would make the
+        # dispatch einsum's backward bounce through a full repartition.
+        w_gate = expert_param('w_gate', (e, d, self.ffn_dim),
+                              ('expert', None, 'mlp'))
+        w_up = expert_param('w_up', (e, d, self.ffn_dim),
+                            ('expert', None, 'mlp'))
+        w_down = expert_param('w_down', (e, self.ffn_dim, d),
+                              ('expert', 'mlp', None))
+
+        xin = x.astype(self.dtype)
+        disp = dispatch.astype(self.dtype)
+        # dispatch: tokens -> per-expert capacity slots (all_to_all when
+        # 'expert' is a real mesh axis)
+        expert_in = jnp.einsum('bsec,bsd->ebcd', disp, xin)
+        expert_in = self._constrain(expert_in, 'expert',
+                                    ('data', 'fsdp'), None, None)
+        h = (nn.silu(jnp.einsum('ebcd,edf->ebcf', expert_in, w_gate)) *
+             jnp.einsum('ebcd,edf->ebcf', expert_in, w_up))
+        h = self._constrain(h, 'expert', ('data', 'fsdp'), None, 'tensor')
+        expert_out = jnp.einsum('ebcf,efd->ebcd', h, w_down)
+        expert_out = self._constrain(expert_out, 'expert',
+                                     ('data', 'fsdp'), None, None)
+        # combine: slots -> tokens, weighted by renormalized gates
+        out = jnp.einsum('ebcd,bsec->bsd', expert_out,
+                         combine.astype(self.dtype))
+        out = self._constrain(out, ('data', 'fsdp', 'expert'), None, None)
+        return out.astype(x.dtype)

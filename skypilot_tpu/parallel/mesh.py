@@ -3,11 +3,16 @@
 Axes (scaling-book recipe: pick a mesh, annotate shardings, let XLA insert
 collectives):
 
+- ``pipeline`` — GPipe-style stage parallelism (outermost: stage hops are
+                 point-to-point, the one pattern that tolerates DCN)
 - ``data``    — pure data parallelism (gradient all-reduce over ICI/DCN)
 - ``fsdp``    — data parallelism with fully-sharded params (ZeRO-3 style);
                 also the context-parallel axis for ring attention (sequence
                 shards travel around this axis's ring)
+- ``expert``  — MoE expert parallelism (experts sharded, tokens all_to_all
+                dispatched); doubles as a data axis for non-MoE layers
 - ``tensor``  — megatron-style tensor parallelism inside a layer
+                (innermost: needs the fastest ICI links)
 
 The TPU ICI torus favors meshes whose fastest-varying axis maps to
 physically adjacent chips; `jax.sharding.Mesh` over `jax.devices()` already
@@ -25,7 +30,7 @@ from typing import Optional, Sequence, Tuple
 import jax
 from jax.sharding import Mesh
 
-MESH_AXES = ('data', 'fsdp', 'tensor')
+MESH_AXES = ('pipeline', 'data', 'fsdp', 'expert', 'tensor')
 
 
 def mesh_axes() -> Tuple[str, ...]:
@@ -34,14 +39,19 @@ def mesh_axes() -> Tuple[str, ...]:
 
 @dataclasses.dataclass(frozen=True)
 class MeshPlan:
-    """Chosen parallelism degrees; product must equal device count."""
+    """Chosen parallelism degrees; product must equal device count.
+    (Field order keeps the historical positional form
+    MeshPlan(data, fsdp, tensor); expert/pipeline are keyword-new.)"""
     data: int = 1
     fsdp: int = 1
     tensor: int = 1
+    expert: int = 1
+    pipeline: int = 1
 
     @property
     def num_devices(self) -> int:
-        return self.data * self.fsdp * self.tensor
+        return (self.data * self.fsdp * self.tensor * self.expert *
+                self.pipeline)
 
     def validate(self, n_devices: int) -> None:
         if self.num_devices != n_devices:
@@ -53,15 +63,18 @@ class MeshPlan:
 def plan_mesh(n_devices: int,
               data: Optional[int] = None,
               fsdp: Optional[int] = None,
-              tensor: Optional[int] = None) -> MeshPlan:
+              tensor: Optional[int] = None,
+              expert: Optional[int] = None,
+              pipeline: Optional[int] = None) -> MeshPlan:
     """Fill in unset axis sizes.
 
-    Policy (matches common TPU practice): tensor parallelism only when asked
-    (it needs the fastest ICI links); remaining devices default to ``fsdp``,
-    which composes with context parallelism and keeps HBM headroom for large
-    models.  `data` absorbs what the caller pins.
+    Policy (matches common TPU practice): tensor/expert/pipeline
+    parallelism only when asked; remaining devices default to ``fsdp``,
+    which composes with context parallelism and keeps HBM headroom for
+    large models.  `data` absorbs what the caller pins.
     """
-    known = {'data': data, 'fsdp': fsdp, 'tensor': tensor}
+    known = {'data': data, 'fsdp': fsdp, 'tensor': tensor,
+             'expert': expert, 'pipeline': pipeline}
     fixed = {k: v for k, v in known.items() if v is not None}
     prod = math.prod(fixed.values()) if fixed else 1
     if n_devices % max(prod, 1) != 0:
@@ -75,11 +88,13 @@ def plan_mesh(n_devices: int,
         fixed['data'] = fixed.get('data', 1) * free
         free = 1
     if free != 1:
-        # All three axes pinned but don't multiply out — validate() catches.
+        # All axes pinned but don't multiply out — validate() catches.
         pass
     plan = MeshPlan(data=fixed.get('data', 1),
                     fsdp=fixed.get('fsdp', 1),
-                    tensor=fixed.get('tensor', 1))
+                    tensor=fixed.get('tensor', 1),
+                    expert=fixed.get('expert', 1),
+                    pipeline=fixed.get('pipeline', 1))
     plan.validate(n_devices)
     return plan
 
@@ -88,11 +103,14 @@ def build_mesh(plan: Optional[MeshPlan] = None,
                devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     """Construct the Mesh.  Device order is `jax.devices()` order, which on a
     TPU slice follows the physical ICI torus — the last mesh axis varies
-    fastest, so put the most communication-hungry axis (`tensor`) last."""
+    fastest, so put the most communication-hungry axis (`tensor`) last and
+    the point-to-point-only axis (`pipeline`) first."""
     devices = list(devices if devices is not None else jax.devices())
     if plan is None:
         plan = plan_mesh(len(devices))
     plan.validate(len(devices))
     import numpy as np
-    dev_array = np.array(devices).reshape(plan.data, plan.fsdp, plan.tensor)
+    dev_array = np.array(devices).reshape(plan.pipeline, plan.data,
+                                          plan.fsdp, plan.expert,
+                                          plan.tensor)
     return Mesh(dev_array, MESH_AXES)

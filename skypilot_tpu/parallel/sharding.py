@@ -17,14 +17,18 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 # shard over tensor; 'batch' over (data, fsdp); 'seq' over fsdp for
 # context parallelism (ring attention).
 DEFAULT_RULES: Tuple[Tuple[str, Optional[object]], ...] = (
-    ('batch', ('data', 'fsdp')),
+    ('batch', ('data', 'fsdp', 'expert')),
     ('seq', None),
     ('embed', 'fsdp'),
     ('mlp', 'tensor'),
     ('heads', 'tensor'),
     ('kv', None),
     ('vocab', 'tensor'),
-    ('expert', 'tensor'),
+    # MoE experts shard over their own mesh axis; tokens are sharded over
+    # it too (batch rule above), so the dispatch/combine einsums become
+    # all_to_alls under pjit.  Non-MoE params ignore the axis (replicated
+    # over it) and their grads all-reduce across it automatically.
+    ('expert', 'expert'),
     ('conv_in', None),
     ('conv_out', 'tensor'),
 )
@@ -58,5 +62,7 @@ def tree_shardings(mesh: Mesh, logical_tree,
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
-    """Sharding for (batch, ...) input arrays: batch over data+fsdp."""
-    return NamedSharding(mesh, P(('data', 'fsdp')))
+    """Sharding for (batch, ...) input arrays: batch over
+    data+fsdp+expert (the expert axis doubles as data parallelism in
+    non-MoE layers)."""
+    return NamedSharding(mesh, P(('data', 'fsdp', 'expert')))
