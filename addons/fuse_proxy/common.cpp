@@ -1,0 +1,150 @@
+#include "common.hpp"
+
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstring>
+
+namespace fuse_proxy {
+
+static bool FillAddr(const std::string& path, sockaddr_un* addr) {
+  if (path.size() >= sizeof(addr->sun_path)) return false;
+  std::memset(addr, 0, sizeof(*addr));
+  addr->sun_family = AF_UNIX;
+  std::strncpy(addr->sun_path, path.c_str(), sizeof(addr->sun_path) - 1);
+  return true;
+}
+
+int ConnectUnix(const std::string& path) {
+  sockaddr_un addr;
+  if (!FillAddr(path, &addr)) return -1;
+  int fd = socket(AF_UNIX, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  if (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    close(fd);
+    return -1;
+  }
+  return fd;
+}
+
+int ListenUnix(const std::string& path, int backlog) {
+  sockaddr_un addr;
+  if (!FillAddr(path, &addr)) return -1;
+  unlink(path.c_str());
+  int fd = socket(AF_UNIX, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      listen(fd, backlog) != 0) {
+    close(fd);
+    return -1;
+  }
+  chmod(path.c_str(), 0666);  // unprivileged pods must reach the server
+  return fd;
+}
+
+bool ReadAll(int fd, void* buf, size_t n) {
+  auto* p = static_cast<uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = read(fd, p, n);
+    if (r < 0 && errno == EINTR) continue;
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool WriteAll(int fd, const void* buf, size_t n) {
+  const auto* p = static_cast<const uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t w = write(fd, p, n);
+    if (w < 0 && errno == EINTR) continue;
+    if (w <= 0) return false;
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+bool WriteU32(int fd, uint32_t v) { return WriteAll(fd, &v, 4); }
+bool ReadU32(int fd, uint32_t* v) { return ReadAll(fd, v, 4); }
+
+bool WriteRequest(int fd, const std::vector<std::string>& argv,
+                  bool want_fd) {
+  if (!WriteU32(fd, static_cast<uint32_t>(argv.size()))) return false;
+  for (const auto& a : argv) {
+    if (!WriteU32(fd, static_cast<uint32_t>(a.size()))) return false;
+    if (!WriteAll(fd, a.data(), a.size())) return false;
+  }
+  uint8_t flag = want_fd ? 1 : 0;
+  return WriteAll(fd, &flag, 1);
+}
+
+bool ReadRequest(int fd, std::vector<std::string>* argv, bool* want_fd) {
+  uint32_t argc;
+  if (!ReadU32(fd, &argc) || argc > 256) return false;
+  argv->clear();
+  for (uint32_t i = 0; i < argc; i++) {
+    uint32_t len;
+    if (!ReadU32(fd, &len) || len > (1u << 20)) return false;
+    std::string s(len, '\0');
+    if (len && !ReadAll(fd, &s[0], len)) return false;
+    argv->push_back(std::move(s));
+  }
+  uint8_t flag;
+  if (!ReadAll(fd, &flag, 1)) return false;
+  *want_fd = flag != 0;
+  return true;
+}
+
+bool SendFd(int sock, int fd, uint8_t byte) {
+  msghdr msg{};
+  iovec iov{&byte, 1};
+  msg.msg_iov = &iov;
+  msg.msg_iovlen = 1;
+  alignas(cmsghdr) char control[CMSG_SPACE(sizeof(int))];
+  if (fd >= 0) {
+    msg.msg_control = control;
+    msg.msg_controllen = sizeof(control);
+    cmsghdr* cmsg = CMSG_FIRSTHDR(&msg);
+    cmsg->cmsg_level = SOL_SOCKET;
+    cmsg->cmsg_type = SCM_RIGHTS;
+    cmsg->cmsg_len = CMSG_LEN(sizeof(int));
+    std::memcpy(CMSG_DATA(cmsg), &fd, sizeof(int));
+  }
+  ssize_t n;
+  do {
+    n = sendmsg(sock, &msg, 0);
+  } while (n < 0 && errno == EINTR);
+  return n == 1;
+}
+
+bool RecvFd(int sock, int* fd, uint8_t* byte) {
+  uint8_t b = 0;
+  msghdr msg{};
+  iovec iov{&b, 1};
+  msg.msg_iov = &iov;
+  msg.msg_iovlen = 1;
+  alignas(cmsghdr) char control[CMSG_SPACE(sizeof(int))];
+  msg.msg_control = control;
+  msg.msg_controllen = sizeof(control);
+  ssize_t n;
+  do {
+    n = recvmsg(sock, &msg, 0);
+  } while (n < 0 && errno == EINTR);
+  if (n != 1) return false;
+  *fd = -1;
+  for (cmsghdr* cmsg = CMSG_FIRSTHDR(&msg); cmsg != nullptr;
+       cmsg = CMSG_NXTHDR(&msg, cmsg)) {
+    if (cmsg->cmsg_level == SOL_SOCKET && cmsg->cmsg_type == SCM_RIGHTS) {
+      std::memcpy(fd, CMSG_DATA(cmsg), sizeof(int));
+    }
+  }
+  if (byte != nullptr) *byte = b;
+  return true;
+}
+
+}  // namespace fuse_proxy

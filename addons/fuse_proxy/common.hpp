@@ -1,0 +1,45 @@
+// Shared wire protocol for the fuse proxy (C++ twin of the reference's
+// Go addons/fuse-proxy/pkg/common — same architecture: a fusermount
+// shim forwards argv over a unix socket to a privileged server, which
+// runs the real fusermount and relays the /dev/fuse fd back via
+// SCM_RIGHTS).
+//
+// Framing (all integers little-endian u32):
+//   request:  argc, then argc x (len, bytes), then want_fd (0/1)
+//   response: exit_code, stderr_len, stderr bytes; if the shim asked
+//             for an fd and the mount succeeded, ONE ancillary
+//             SCM_RIGHTS fd rides on the response's first byte.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace fuse_proxy {
+
+// Default socket path; override with FUSE_PROXY_SOCKET.
+constexpr const char* kDefaultSocket = "/var/run/fuse-proxy/fuse-proxy.sock";
+
+// Env var libfuse uses to tell fusermount where to send the mount fd.
+constexpr const char* kCommFdEnv = "_FUSE_COMMFD";
+
+int ConnectUnix(const std::string& path);
+int ListenUnix(const std::string& path, int backlog = 16);
+
+// Exact-length read/write; return false on error/EOF.
+bool ReadAll(int fd, void* buf, size_t n);
+bool WriteAll(int fd, const void* buf, size_t n);
+
+bool WriteU32(int fd, uint32_t v);
+bool ReadU32(int fd, uint32_t* v);
+
+bool WriteRequest(int fd, const std::vector<std::string>& argv,
+                  bool want_fd);
+bool ReadRequest(int fd, std::vector<std::string>* argv, bool* want_fd);
+
+// Send one byte carrying an SCM_RIGHTS fd (fd < 0: plain byte).
+bool SendFd(int sock, int fd, uint8_t byte = 0);
+// Receive one byte + optional fd (-1 if none attached).
+bool RecvFd(int sock, int* fd, uint8_t* byte = nullptr);
+
+}  // namespace fuse_proxy

@@ -1,0 +1,142 @@
+// Privileged fuse-proxy server (C++ twin of the reference's Go
+// cmd/fusermount-server + pkg/server — runs as a DaemonSet on each k8s
+// node with SYS_ADMIN; unprivileged pods reach it over a host-shared
+// unix socket).
+//
+// Per connection: read the shim's fusermount argv, exec the REAL
+// fusermount with a private _FUSE_COMMFD socketpair, capture the
+// /dev/fuse fd fusermount sends back, and relay (exit code, stderr,
+// fd) to the shim.
+//
+// Usage: fusermount-server [--socket PATH] [--fusermount BIN]
+#include <sys/socket.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <csignal>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "common.hpp"
+
+namespace {
+
+using fuse_proxy::kCommFdEnv;
+
+struct Result {
+  int exit_code = 1;
+  std::string stderr_out;
+  int fuse_fd = -1;
+};
+
+Result RunFusermount(const std::string& bin,
+                     const std::vector<std::string>& argv, bool want_fd) {
+  Result res;
+  int comm[2] = {-1, -1};
+  if (want_fd &&
+      socketpair(AF_UNIX, SOCK_STREAM, 0, comm) != 0) {
+    res.stderr_out = "fuse-proxy: socketpair failed\n";
+    return res;
+  }
+  int errpipe[2];
+  if (pipe(errpipe) != 0) {
+    res.stderr_out = "fuse-proxy: pipe failed\n";
+    return res;
+  }
+  pid_t pid = fork();
+  if (pid < 0) {
+    res.stderr_out = "fuse-proxy: fork failed\n";
+    return res;
+  }
+  if (pid == 0) {
+    // Child: exec the real fusermount with our comm socket.
+    close(errpipe[0]);
+    dup2(errpipe[1], 2);
+    if (want_fd) {
+      close(comm[0]);
+      setenv(kCommFdEnv, std::to_string(comm[1]).c_str(), 1);
+    }
+    std::vector<char*> cargv;
+    cargv.push_back(const_cast<char*>(bin.c_str()));
+    for (size_t i = 1; i < argv.size(); i++) {
+      cargv.push_back(const_cast<char*>(argv[i].c_str()));
+    }
+    cargv.push_back(nullptr);
+    execvp(bin.c_str(), cargv.data());
+    std::perror("fuse-proxy: execvp");
+    _exit(127);
+  }
+  close(errpipe[1]);
+  if (want_fd) close(comm[1]);
+  // Drain stderr (fusermount writes little; read fully before wait).
+  char buf[4096];
+  ssize_t n;
+  while ((n = read(errpipe[0], buf, sizeof(buf))) > 0) {
+    res.stderr_out.append(buf, static_cast<size_t>(n));
+  }
+  close(errpipe[0]);
+  if (want_fd) {
+    // fusermount sends the mount fd before exiting; non-blockingly
+    // attempt the receive after it exits too (order isn't guaranteed).
+    int fd = -1;
+    if (fuse_proxy::RecvFd(comm[0], &fd)) res.fuse_fd = fd;
+    close(comm[0]);
+  }
+  int status = 0;
+  waitpid(pid, &status, 0);
+  res.exit_code = WIFEXITED(status) ? WEXITSTATUS(status) : 128;
+  return res;
+}
+
+void Serve(int conn, const std::string& bin) {
+  std::vector<std::string> argv;
+  bool want_fd = false;
+  if (!fuse_proxy::ReadRequest(conn, &argv, &want_fd) || argv.empty()) {
+    close(conn);
+    return;
+  }
+  Result res = RunFusermount(bin, argv, want_fd);
+  // Response: first byte (with optional SCM_RIGHTS fd), then exit code
+  // and stderr.
+  fuse_proxy::SendFd(conn, res.fuse_fd,
+                     static_cast<uint8_t>(res.fuse_fd >= 0 ? 1 : 0));
+  fuse_proxy::WriteU32(conn, static_cast<uint32_t>(res.exit_code));
+  fuse_proxy::WriteU32(conn,
+                       static_cast<uint32_t>(res.stderr_out.size()));
+  fuse_proxy::WriteAll(conn, res.stderr_out.data(),
+                       res.stderr_out.size());
+  if (res.fuse_fd >= 0) close(res.fuse_fd);
+  close(conn);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string socket_path = fuse_proxy::kDefaultSocket;
+  std::string fusermount_bin = "fusermount";
+  for (int i = 1; i < argc - 1; i++) {
+    if (std::strcmp(argv[i], "--socket") == 0) socket_path = argv[i + 1];
+    if (std::strcmp(argv[i], "--fusermount") == 0) {
+      fusermount_bin = argv[i + 1];
+    }
+  }
+  if (const char* env = getenv("FUSE_PROXY_SOCKET")) socket_path = env;
+  signal(SIGCHLD, SIG_DFL);
+  signal(SIGPIPE, SIG_IGN);
+  int lfd = fuse_proxy::ListenUnix(socket_path);
+  if (lfd < 0) {
+    std::fprintf(stderr, "fuse-proxy: cannot listen on %s\n",
+                 socket_path.c_str());
+    return 1;
+  }
+  std::fprintf(stderr, "fuse-proxy: serving on %s (fusermount=%s)\n",
+               socket_path.c_str(), fusermount_bin.c_str());
+  for (;;) {
+    int conn = accept(lfd, nullptr, nullptr);
+    if (conn < 0) continue;
+    Serve(conn, fusermount_bin);  // requests are short; serial is fine
+  }
+}

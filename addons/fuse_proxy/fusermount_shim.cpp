@@ -1,0 +1,66 @@
+// fusermount shim (C++ twin of the reference's Go cmd/fusermount-shim):
+// installed AS `fusermount` inside unprivileged pods.  libfuse execs it
+// expecting the real thing; it forwards argv to the privileged server
+// and, for mounts, relays the returned /dev/fuse fd to libfuse over the
+// _FUSE_COMMFD socket — so FUSE mounts work without SYS_ADMIN in the
+// pod.
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "common.hpp"
+
+int main(int argc, char** argv) {
+  std::string socket_path = fuse_proxy::kDefaultSocket;
+  if (const char* env = getenv("FUSE_PROXY_SOCKET")) socket_path = env;
+
+  const char* commfd_env = getenv(fuse_proxy::kCommFdEnv);
+  bool want_fd = commfd_env != nullptr;
+
+  int conn = fuse_proxy::ConnectUnix(socket_path);
+  if (conn < 0) {
+    std::fprintf(stderr,
+                 "fusermount-shim: cannot reach fuse-proxy server at "
+                 "%s\n", socket_path.c_str());
+    return 1;
+  }
+  std::vector<std::string> args(argv, argv + argc);
+  if (!fuse_proxy::WriteRequest(conn, args, want_fd)) {
+    std::fprintf(stderr, "fusermount-shim: request failed\n");
+    return 1;
+  }
+  int fuse_fd = -1;
+  uint8_t has_fd = 0;
+  if (!fuse_proxy::RecvFd(conn, &fuse_fd, &has_fd)) {
+    std::fprintf(stderr, "fusermount-shim: response failed\n");
+    return 1;
+  }
+  uint32_t exit_code = 1, err_len = 0;
+  if (!fuse_proxy::ReadU32(conn, &exit_code) ||
+      !fuse_proxy::ReadU32(conn, &err_len) || err_len > (1u << 20)) {
+    std::fprintf(stderr, "fusermount-shim: bad response header\n");
+    return 1;
+  }
+  std::string err(err_len, '\0');
+  if (err_len && !fuse_proxy::ReadAll(conn, &err[0], err_len)) {
+    return 1;
+  }
+  if (!err.empty()) std::fwrite(err.data(), 1, err.size(), stderr);
+  close(conn);
+
+  if (want_fd && fuse_fd >= 0) {
+    // Relay the mount fd to libfuse exactly as real fusermount would.
+    int commfd = std::atoi(commfd_env);
+    if (!fuse_proxy::SendFd(commfd, fuse_fd)) {
+      std::fprintf(stderr, "fusermount-shim: fd relay failed\n");
+      close(fuse_fd);
+      return 1;
+    }
+    close(fuse_fd);
+  }
+  return static_cast<int>(exit_code);
+}

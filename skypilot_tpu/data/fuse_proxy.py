@@ -1,0 +1,74 @@
+"""Python integration for the native fuse-proxy (addons/fuse_proxy).
+
+The reference ships a Go fuse-proxy (addons/fuse-proxy: fusermount-shim
+client masking `fusermount` + a privileged DaemonSet server over a unix
+socket) so unprivileged k8s pods can FUSE-mount buckets
+(addons/fuse-proxy/README.md:1-13).  Ours is C++ with the same
+architecture; this module builds the binaries and manages a server for
+tests/deployments.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import time
+from typing import Optional
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+ADDON_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), 'addons', 'fuse_proxy')
+
+
+def build(force: bool = False) -> str:
+    """`make` the shim+server; returns the bin dir."""
+    bin_dir = os.path.join(ADDON_DIR, 'bin')
+    server = os.path.join(bin_dir, 'fusermount-server')
+    shim = os.path.join(bin_dir, 'fusermount-shim')
+    if force or not (os.path.exists(server) and os.path.exists(shim)):
+        subprocess.run(['make', '-C', ADDON_DIR], check=True,
+                       capture_output=True)
+    return bin_dir
+
+
+def server_binary() -> str:
+    return os.path.join(build(), 'fusermount-server')
+
+
+def shim_binary() -> str:
+    return os.path.join(build(), 'fusermount-shim')
+
+
+class FuseProxyServer:
+    """Run a fusermount-server on a socket (tests / single-host use; on
+    k8s the server is a privileged DaemonSet from the same binary)."""
+
+    def __init__(self, socket_path: str,
+                 fusermount_bin: str = 'fusermount') -> None:
+        self.socket_path = socket_path
+        self.fusermount_bin = fusermount_bin
+        self._proc: Optional[subprocess.Popen] = None
+
+    def start(self, timeout_s: float = 10.0) -> None:
+        self._proc = subprocess.Popen(
+            [server_binary(), '--socket', self.socket_path,
+             '--fusermount', self.fusermount_bin],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            if os.path.exists(self.socket_path):
+                return
+            time.sleep(0.05)
+        raise RuntimeError('fuse-proxy server did not come up')
+
+    def stop(self) -> None:
+        if self._proc is not None:
+            self._proc.terminate()
+            try:
+                self._proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
+            self._proc = None

@@ -1,0 +1,121 @@
+"""Native fuse-proxy e2e: shim -> unix socket -> server -> (fake)
+fusermount, with the /dev/fuse fd relayed back through both hops via
+SCM_RIGHTS.  The fake fusermount opens a real file and speaks the actual
+_FUSE_COMMFD protocol, so the whole fd-passing chain is exercised
+without FUSE or privileges (reference analog:
+addons/fuse-proxy/cmd/fusermount-shim/main.go)."""
+import array
+import os
+import socket
+import stat
+import subprocess
+
+import pytest
+
+from skypilot_tpu.data import fuse_proxy
+
+FAKE_FUSERMOUNT = r'''#!/usr/bin/env python3
+# Fake fusermount: records argv, sends an fd over _FUSE_COMMFD exactly
+# like the real one, exits with a scripted code.
+import array, os, socket, sys
+args_log = os.environ['FAKE_LOG']
+with open(args_log, 'w') as f:
+    f.write('\n'.join(sys.argv[1:]))
+sys.stderr.write('fake-fusermount ran\n')
+commfd = os.environ.get('_FUSE_COMMFD')
+if commfd is not None:
+    payload = os.environ['FAKE_PAYLOAD_FILE']
+    fd = os.open(payload, os.O_RDWR)
+    sock = socket.socket(fileno=int(commfd))
+    sock.sendmsg([b'\0'], [(socket.SOL_SOCKET, socket.SCM_RIGHTS,
+                            array.array('i', [fd]))])
+    sock.close()
+code = 0
+exit_file = os.environ.get('FAKE_EXIT_FILE')
+if exit_file and os.path.exists(exit_file):
+    code = int(open(exit_file).read().strip() or 0)
+sys.exit(code)
+'''
+
+
+@pytest.fixture(scope='module')
+def binaries():
+    return fuse_proxy.build()
+
+
+@pytest.fixture
+def proxy(binaries, tmp_path):
+    fake = tmp_path / 'fake-fusermount'
+    fake.write_text(FAKE_FUSERMOUNT)
+    fake.chmod(fake.stat().st_mode | stat.S_IEXEC)
+    payload = tmp_path / 'payload.bin'
+    payload.write_bytes(b'hello-from-dev-fuse')
+    sock_path = tmp_path / 'fp.sock'
+    server = fuse_proxy.FuseProxyServer(str(sock_path),
+                                        fusermount_bin=str(fake))
+    env = dict(os.environ)
+    env.update({
+        'FUSE_PROXY_SOCKET': str(sock_path),
+        'FAKE_LOG': str(tmp_path / 'argv.log'),
+        'FAKE_PAYLOAD_FILE': str(payload),
+        'FAKE_EXIT_FILE': str(tmp_path / 'exit.txt'),
+    })
+    # The fake fusermount runs with the SERVER's environment (the server
+    # fork/execs it), so these must be set before the server starts.
+    os.environ.update({k: env[k] for k in
+                       ('FAKE_LOG', 'FAKE_PAYLOAD_FILE',
+                        'FAKE_EXIT_FILE')})
+    server.start()
+    yield {'sock': str(sock_path), 'env': env, 'tmp': tmp_path}
+    server.stop()
+    for k in ('FAKE_LOG', 'FAKE_PAYLOAD_FILE', 'FAKE_EXIT_FILE'):
+        os.environ.pop(k, None)
+
+
+def _run_shim(env, extra_args, commfd=None):
+    argv = [fuse_proxy.shim_binary()] + extra_args
+    return subprocess.run(argv, env=env, capture_output=True,
+                          pass_fds=(commfd,) if commfd is not None else ())
+
+
+def test_shim_relays_argv_exit_code_and_stderr(proxy):
+    env = dict(proxy['env'])
+    env.pop('_FUSE_COMMFD', None)
+    res = _run_shim(env, ['-u', '/mnt/x'])
+    assert res.returncode == 0
+    assert b'fake-fusermount ran' in res.stderr
+    logged = (proxy['tmp'] / 'argv.log').read_text().splitlines()
+    assert logged == ['-u', '/mnt/x']
+
+
+def test_shim_propagates_failure_exit(proxy):
+    env = dict(proxy['env'])
+    env.pop('_FUSE_COMMFD', None)
+    (proxy['tmp'] / 'exit.txt').write_text('3')
+    try:
+        res = _run_shim(env, ['/mnt/y'])
+    finally:
+        (proxy['tmp'] / 'exit.txt').unlink()
+    assert res.returncode == 3
+
+
+def test_mount_fd_relayed_end_to_end(proxy):
+    # libfuse side: a socketpair whose far end goes to the shim as
+    # _FUSE_COMMFD; the fd that arrives must be the fake's payload file.
+    ours, theirs = socket.socketpair()
+    env = dict(proxy['env'])
+    env['_FUSE_COMMFD'] = str(theirs.fileno())
+    res = _run_shim(env, ['-o', 'rw', '/mnt/bucket'],
+                    commfd=theirs.fileno())
+    theirs.close()
+    assert res.returncode == 0, res.stderr
+    msg, ancdata, _flags, _addr = ours.recvmsg(
+        1, socket.CMSG_SPACE(array.array('i').itemsize * 1))
+    ours.close()
+    assert ancdata, 'no fd arrived over _FUSE_COMMFD'
+    fds = array.array('i')
+    fds.frombytes(ancdata[0][2])
+    fd = fds[0]
+    data = os.read(fd, 64)
+    os.close(fd)
+    assert data == b'hello-from-dev-fuse'   # same file, through 2 hops
