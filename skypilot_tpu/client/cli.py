@@ -237,11 +237,33 @@ def jobs():
 @click.argument('entrypoint', nargs=-1)
 @_apply(_task_options)
 def jobs_launch(entrypoint, cluster, detach_run, **overrides):
-    """Launch a managed job (auto-recovers from preemption)."""
+    """Launch a managed job (auto-recovers from preemption).
+
+    A multi-document YAML entrypoint is a pipeline: its tasks run
+    sequentially, each on its own ephemeral cluster."""
     del cluster  # managed jobs own their ephemeral clusters
-    task = _load_task(entrypoint, **overrides)
-    result = sdk.get(sdk.jobs_launch(task, overrides.get('name')))
-    click.echo(f'Managed job {result["job_id"]} submitted.')
+    name = overrides.get('name')
+    pipeline = None
+    if len(entrypoint) == 1 and entrypoint[0].endswith(('.yaml', '.yml')):
+        from skypilot_tpu import dag as dag_lib
+        from skypilot_tpu.utils import common_utils
+        if len(common_utils.read_yaml_all(entrypoint[0])) > 1:
+            if any(v not in (None, False, 0) for k, v in overrides.items()
+                   if k != 'name'):
+                raise click.UsageError(
+                    'task override flags (--infra, --accelerators, ...) '
+                    'are not supported with pipeline YAMLs; set resources '
+                    'per task in the YAML instead.')
+            pipeline = dag_lib.load_chain_dag_from_yaml(entrypoint[0])
+    if pipeline is not None:
+        result = sdk.get(sdk.jobs_launch(
+            pipeline.topological_order(), name or pipeline.name))
+        click.echo(f'Managed job {result["job_id"]} submitted '
+                   f'({len(pipeline)}-task pipeline).')
+    else:
+        task = _load_task(entrypoint, **overrides)
+        result = sdk.get(sdk.jobs_launch(task, name))
+        click.echo(f'Managed job {result["job_id"]} submitted.')
     if not detach_run:
         import time as _time
         from skypilot_tpu.jobs.state import TERMINAL_STATUS_VALUES \
@@ -274,14 +296,18 @@ def jobs_queue_cmd():
     """List managed jobs."""
     rows = []
     for r in sdk.jobs_queue():
+        n_tasks = r.get('num_tasks', 1)
+        task_col = (f'{r.get("task_index", 0) + 1}/{n_tasks}'
+                    if n_tasks > 1 else '-')
         rows.append([
-            r['job_id'], r.get('name') or '-', r['status'],
+            r['job_id'], r.get('name') or '-', r['status'], task_col,
             r.get('cluster_name') or '-',
             r.get('recovery_count', 0),
             (r.get('failure_reason') or '')[:40],
         ])
     ux_utils.print_table(
-        ['ID', 'NAME', 'STATUS', 'CLUSTER', 'RECOVERIES', 'REASON'], rows)
+        ['ID', 'NAME', 'STATUS', 'TASK', 'CLUSTER', 'RECOVERIES',
+         'REASON'], rows)
 
 
 @jobs.command('cancel')

@@ -164,9 +164,16 @@ def tail_logs(cluster_name: str, job_id: int, follow: bool = True,
 
 
 # ----- managed jobs ----------------------------------------------------------
-def jobs_launch(task: task_lib.Task, name: Optional[str] = None) -> str:
-    return _post('/jobs/launch', {'task': task.to_yaml_config(),
-                                  'name': name})['request_id']
+def jobs_launch(task_or_tasks, name: Optional[str] = None) -> str:
+    """Launch a managed job: one Task, or a list of Tasks run as a
+    chain pipeline (each on its own ephemeral cluster)."""
+    if isinstance(task_or_tasks, (list, tuple)):
+        body: Dict[str, Any] = {
+            'tasks': [t.to_yaml_config() for t in task_or_tasks]}
+    else:
+        body = {'task': task_or_tasks.to_yaml_config()}
+    body['name'] = name
+    return _post('/jobs/launch', body)['request_id']
 
 
 def jobs_queue() -> List[Dict[str, Any]]:

@@ -24,6 +24,7 @@ provision.query_instances), sky/backends/backend_utils.py:2222.
 """
 from __future__ import annotations
 
+import enum
 import os
 import threading
 import time
@@ -37,11 +38,19 @@ from skypilot_tpu.agent.job_queue import JobStatus as ClusterJobStatus
 from skypilot_tpu.backends import TpuVmBackend
 from skypilot_tpu.backends import backend_utils
 from skypilot_tpu.global_user_state import ClusterStatus
+from skypilot_tpu.jobs import recovery_strategy as recovery_lib
 from skypilot_tpu.jobs import state
 from skypilot_tpu.jobs.recovery_strategy import StrategyExecutor
 from skypilot_tpu.jobs.state import ManagedJobStatus
 
 logger = sky_logging.init_logger(__name__)
+
+
+class _TaskOutcome(enum.Enum):
+    """How one pipeline task ended."""
+    SUCCEEDED = 'SUCCEEDED'
+    CANCELLED = 'CANCELLED'
+    FAILED = 'FAILED'
 
 
 def _poll_interval() -> float:
@@ -53,8 +62,11 @@ def _poll_interval() -> float:
 _LOST_JOB_POLLS = int(os.environ.get('SKYTPU_JOBS_LOST_JOB_POLLS', '6'))
 
 
-def cluster_name_for_job(job_id: int, name: Optional[str]) -> str:
+def cluster_name_for_job(job_id: int, name: Optional[str],
+                         task_index: int = 0, num_tasks: int = 1) -> str:
     base = (name or 'task').lower().replace('_', '-')[:20].strip('-')
+    if num_tasks > 1:
+        return f'jobs-{job_id}-t{task_index}-{base}'
     return f'jobs-{job_id}-{base}'
 
 
@@ -136,40 +148,80 @@ class JobController:
 
     # ----- main loop ---------------------------------------------------------
     def run(self) -> None:
+        """Drive every task of the job's (chain) dag to completion.
+
+        The reference controller iterates dag tasks sequentially with one
+        strategy executor per task (sky/jobs/controller.py:98); here the
+        per-task progress (``task_index``) persists in the jobs DB so an
+        API-server restart re-adopts a pipeline at the task it was on,
+        not at the beginning.
+        """
         rec = state.get(self.job_id)
         if rec is None or rec['status'].is_terminal():
             return
-        task = task_lib.Task.from_yaml_config(rec['task_config'])
-        cluster_name = rec['cluster_name'] or cluster_name_for_job(
-            self.job_id, rec['name'] or task.name)
-        strategy = StrategyExecutor.make(task, cluster_name,
-                                         rec['recovery_strategy'])
+        configs = rec['task_configs']
+        strategy: Optional[StrategyExecutor] = None
         try:
-            self._run_inner(rec, strategy)
+            for idx in range(rec['task_index'], len(configs)):
+                rec = state.get(self.job_id)
+                task = task_lib.Task.from_yaml_config(configs[idx])
+                cluster_name = rec['cluster_name'] or cluster_name_for_job(
+                    self.job_id, task.name or rec['name'], idx,
+                    len(configs))
+                strat_name, max_restarts = recovery_lib.task_recovery_config(
+                    task, rec['recovery_strategy'],
+                    int(rec['max_restarts_on_errors'] or 0))
+                strategy = StrategyExecutor.make(task, cluster_name,
+                                                 strat_name)
+                outcome = self._run_task(rec, strategy, max_restarts)
+                if outcome is not _TaskOutcome.SUCCEEDED:
+                    return      # terminal status already recorded
+                if idx + 1 < len(configs):
+                    logger.info(f'Managed job {self.job_id}: task '
+                                f'{idx + 1}/{len(configs)} done, '
+                                f'advancing.')
+                    state.advance_task(self.job_id, idx + 1)
+                else:
+                    state.set_status(self.job_id,
+                                     ManagedJobStatus.SUCCEEDED)
+                    logger.info(f'Managed job {self.job_id} SUCCEEDED.')
+        except exceptions.ClusterSetupError as e:
+            # Setup failure is deterministic (bad image, bad deps):
+            # restarting re-runs the same broken setup, so it is
+            # immediately terminal and never counts against
+            # max_restarts_on_errors (reference:
+            # recovery_strategy.should_restart_on_failure).
+            logger.warning(f'Managed job {self.job_id}: setup failed: {e}')
+            state.set_status(self.job_id,
+                             ManagedJobStatus.FAILED_SETUP, str(e))
+            if strategy is not None:
+                strategy.cleanup()
         except exceptions.ResourcesUnavailableError as e:
             logger.warning(f'Managed job {self.job_id}: placements '
                            f'exhausted: {e}')
             state.set_status(self.job_id,
                              ManagedJobStatus.FAILED_NO_RESOURCE, str(e))
-            strategy.cleanup()
+            if strategy is not None:
+                strategy.cleanup()
         except Exception as e:  # pylint: disable=broad-except
             logger.exception(f'Managed job {self.job_id}: controller '
                              f'crashed')
             state.set_status(self.job_id,
                              ManagedJobStatus.FAILED_CONTROLLER, repr(e))
-            strategy.cleanup()
+            if strategy is not None:
+                strategy.cleanup()
         finally:
             maybe_start_controllers()
 
-    def _run_inner(self, rec: dict, strategy: StrategyExecutor) -> None:
+    def _run_task(self, rec: dict, strategy: StrategyExecutor,
+                  max_restarts: int) -> '_TaskOutcome':
         job_id = self.job_id
         cluster_name = strategy.cluster_name
-        max_restarts = int(rec['max_restarts_on_errors'] or 0)
         cluster_job_id = rec['cluster_job_id']
 
         if self._cancel_requested():
             self._finish_cancel(strategy, cluster_job_id)
-            return
+            return _TaskOutcome.CANCELLED
         if cluster_job_id is None:
             state.set_status(job_id, ManagedJobStatus.STARTING)
             state.set_cluster(job_id, cluster_name, None)
@@ -187,23 +239,22 @@ class JobController:
         while True:
             if self._cancel_requested():
                 self._finish_cancel(strategy, cluster_job_id)
-                return
+                return _TaskOutcome.CANCELLED
             status = self._cluster_job_status(cluster_name, cluster_job_id)
             if status is ClusterJobStatus.SUCCEEDED:
-                # Snapshot before marking terminal: jobs-logs readers
-                # switch to the snapshot the moment the status flips.
+                # Snapshot before the cluster goes away: jobs-logs
+                # readers switch to the snapshot once the job record says
+                # terminal (or the cluster record is gone).
                 self._snapshot_logs(cluster_name, cluster_job_id)
-                state.set_status(job_id, ManagedJobStatus.SUCCEEDED)
                 strategy.cleanup()
-                logger.info(f'Managed job {job_id} SUCCEEDED.')
-                return
+                return _TaskOutcome.SUCCEEDED
             if status is ClusterJobStatus.CANCELLED:
                 # Cancelled out-of-band on the cluster itself.
                 self._snapshot_logs(cluster_name, cluster_job_id)
                 state.set_status(job_id, ManagedJobStatus.CANCELLED,
                                  'cluster job cancelled externally')
                 strategy.cleanup()
-                return
+                return _TaskOutcome.CANCELLED
             # Non-success: reconcile against cloud truth BEFORE judging.
             # A gang failure can be the *symptom* of preemption (a dead
             # host kills every rank), and a slice can be preempted while
@@ -238,28 +289,37 @@ class JobController:
                 state.set_status(job_id, ManagedJobStatus.RECOVERING)
                 if self._cancel_requested():
                     self._finish_cancel(strategy, None)
-                    return
+                    return _TaskOutcome.CANCELLED
                 cluster_job_id = strategy.recover()
                 state.set_cluster(job_id, cluster_name, cluster_job_id)
                 state.set_status(job_id, ManagedJobStatus.RUNNING)
                 unknown_streak = 0
                 continue
-            if status in (ClusterJobStatus.FAILED,
-                          ClusterJobStatus.FAILED_SETUP):
+            if status is ClusterJobStatus.FAILED_SETUP:
+                # Setup failure is deterministic (bad image, bad deps):
+                # restarting re-runs the same broken setup, so it is
+                # immediately terminal and does NOT count against
+                # max_restarts_on_errors (reference:
+                # recovery_strategy.should_restart_on_failure treats
+                # FAILED_SETUP as non-restartable).
+                self._snapshot_logs(cluster_name, cluster_job_id)
+                state.set_status(
+                    job_id, ManagedJobStatus.FAILED_SETUP,
+                    f'cluster job {cluster_job_id} failed in setup')
+                strategy.cleanup()
+                return _TaskOutcome.FAILED
+            if status is ClusterJobStatus.FAILED:
                 # Genuine user-code failure on a healthy cluster: counts
                 # against max_restarts_on_errors.
                 n = state.bump_restarts_on_errors(job_id)
                 if n > max_restarts:
-                    final = (ManagedJobStatus.FAILED_SETUP if status is
-                             ClusterJobStatus.FAILED_SETUP else
-                             ManagedJobStatus.FAILED)
                     self._snapshot_logs(cluster_name, cluster_job_id)
                     state.set_status(
-                        job_id, final,
+                        job_id, ManagedJobStatus.FAILED,
                         f'cluster job {cluster_job_id} '
                         f'{status.value} (restarted {n - 1}x)')
                     strategy.cleanup()
-                    return
+                    return _TaskOutcome.FAILED
                 logger.info(
                     f'Managed job {job_id}: user-code failure, '
                     f'restart {n}/{max_restarts}.')

@@ -11,39 +11,53 @@ from skypilot_tpu import global_user_state
 from skypilot_tpu import task as task_lib
 from skypilot_tpu.jobs import controller as controller_lib
 from skypilot_tpu.jobs import state
-from skypilot_tpu.jobs.recovery_strategy import StrategyName
+from skypilot_tpu.jobs.recovery_strategy import (StrategyName,
+                                                 task_recovery_config)
 
 
 def _recovery_config(task: task_lib.Task) -> Dict[str, Any]:
-    """Parse `job_recovery` off the task's resources: either a strategy
-    name string or {strategy, max_restarts_on_errors}."""
-    raw = task.any_resources.job_recovery
-    if raw is None:
-        return {'strategy': StrategyName.FAILOVER.value,
-                'max_restarts_on_errors': 0}
-    if isinstance(raw, str):
-        return {'strategy': raw.upper(), 'max_restarts_on_errors': 0}
-    if isinstance(raw, dict):
-        return {
-            'strategy': str(raw.get('strategy', 'FAILOVER')).upper(),
-            'max_restarts_on_errors': int(
-                raw.get('max_restarts_on_errors', 0)),
-        }
-    raise exceptions.InvalidResourcesError(
-        f'job_recovery must be a string or object, got {raw!r}')
+    """Parse `job_recovery` off the task's resources (single source of
+    truth: recovery_strategy.task_recovery_config)."""
+    strategy, max_restarts = task_recovery_config(
+        task, StrategyName.FAILOVER.value, 0)
+    return {'strategy': strategy, 'max_restarts_on_errors': max_restarts}
 
 
-def launch(task: task_lib.Task, name: Optional[str] = None) -> int:
+def launch(task_or_dag, name: Optional[str] = None) -> int:
     """Submit a managed (auto-recovering) job; returns the managed job id.
 
-    The controller provisions an ephemeral task cluster, monitors it, and
-    on preemption deletes the stale slice, re-provisions (failing over
-    zones as needed) and re-runs the task, which resumes from its latest
-    checkpoint.
+    Accepts a single Task or a chain Dag (a pipeline: the controller runs
+    the tasks sequentially, each on its own ephemeral cluster, with
+    per-task recovery — parity: sky/jobs/controller.py:98 iterating dag
+    tasks).  On preemption the controller deletes the stale slice,
+    re-provisions (failing over zones as needed) and re-runs the current
+    task, which resumes from its latest checkpoint.
     """
-    rec = _recovery_config(task)
+    from skypilot_tpu import dag as dag_lib
+    if isinstance(task_or_dag, dag_lib.Dag):
+        dag = task_or_dag
+        dag.validate()
+        if len(dag) > 1 and not dag.is_chain():
+            raise exceptions.InvalidDagError(
+                'managed jobs support single tasks or linear pipelines; '
+                'general DAGs are not supported (same as the reference, '
+                'sky/jobs/server/core.py)')
+        tasks = dag.topological_order() if len(dag) > 1 else dag.tasks
+        job_name = name or dag.name or (tasks[0].name if tasks else None)
+    else:
+        tasks = [task_or_dag]
+        job_name = name or task_or_dag.name
+    if not tasks:
+        raise exceptions.InvalidDagError('managed job needs >= 1 task')
+    # Job-level defaults come from the first task; tasks with their own
+    # job_recovery override per task in the controller.
+    rec = _recovery_config(tasks[0])
     StrategyName(rec['strategy'])  # validate early, before persisting
-    job_id = state.submit(name or task.name, task.to_yaml_config(),
+    for t in tasks[1:]:
+        s, _ = task_recovery_config(t, rec['strategy'], 0)
+        StrategyName(s)
+    job_id = state.submit(job_name,
+                          [t.to_yaml_config() for t in tasks],
                           recovery_strategy=rec['strategy'],
                           max_restarts_on_errors=rec[
                               'max_restarts_on_errors'])

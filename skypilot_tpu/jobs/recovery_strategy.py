@@ -37,6 +37,28 @@ class StrategyName(enum.Enum):
     EAGER_FAILOVER = 'EAGER_FAILOVER'
 
 
+def task_recovery_config(task: task_lib.Task,
+                         default_strategy: str = 'FAILOVER',
+                         default_max_restarts: int = 0):
+    """(strategy_name, max_restarts_on_errors) for one task.
+
+    Tasks carrying their own ``job_recovery`` (string or
+    {strategy, max_restarts_on_errors}) override the job-level defaults —
+    the reference builds one strategy executor per dag task
+    (sky/jobs/controller.py:98)."""
+    raw = task.any_resources.job_recovery
+    if raw is None:
+        return default_strategy, default_max_restarts
+    if isinstance(raw, str):
+        return raw.upper(), default_max_restarts
+    if isinstance(raw, dict):
+        return (str(raw.get('strategy', default_strategy)).upper(),
+                int(raw.get('max_restarts_on_errors',
+                            default_max_restarts)))
+    raise exceptions.InvalidResourcesError(
+        f'job_recovery must be a string or object, got {raw!r}')
+
+
 class StrategyExecutor:
     """Launch/recover one managed job's task cluster."""
 

@@ -69,8 +69,14 @@ _DDL = [
         max_restarts_on_errors INTEGER DEFAULT 0,
         restarts_on_errors INTEGER DEFAULT 0,
         recovery_strategy TEXT DEFAULT 'FAILOVER',
-        failure_reason TEXT
+        failure_reason TEXT,
+        task_index INTEGER DEFAULT 0,
+        num_tasks INTEGER DEFAULT 1
     )""",
+    # Idempotent migrations for DBs created before pipeline support
+    # (ensure_schema swallows duplicate-column errors).
+    "ALTER TABLE managed_jobs ADD COLUMN task_index INTEGER DEFAULT 0",
+    "ALTER TABLE managed_jobs ADD COLUMN num_tasks INTEGER DEFAULT 1",
 ]
 
 
@@ -88,18 +94,30 @@ def log_path(job_id: int) -> str:
                         f'{job_id}.log')
 
 
-def submit(name: Optional[str], task_config: Dict[str, Any],
-           recovery_strategy: str = 'FAILOVER',
+def submit(name: Optional[str], task_config, recovery_strategy: str = 'FAILOVER',
            max_restarts_on_errors: int = 0) -> int:
+    """Persist a new managed job.
+
+    ``task_config`` is one task's YAML config (dict) or, for a pipeline
+    (parity: the reference controller iterates dag tasks,
+    sky/jobs/controller.py:98), a list of task configs executed as a
+    chain.  ``recovery_strategy``/``max_restarts_on_errors`` are
+    job-level defaults; tasks carrying their own ``job_recovery``
+    override them per task.
+    """
+    configs = (list(task_config) if isinstance(task_config, list)
+               else [task_config])
+    if not configs:
+        raise ValueError('managed job needs at least one task')
     path = _ensure()
     with db_utils.transaction(path) as conn:
         cur = conn.execute(
             'INSERT INTO managed_jobs (name, task_config, status, '
-            'submitted_at, recovery_strategy, max_restarts_on_errors) '
-            'VALUES (?,?,?,?,?,?)',
-            (name, json.dumps(task_config),
+            'submitted_at, recovery_strategy, max_restarts_on_errors, '
+            'task_index, num_tasks) VALUES (?,?,?,?,?,?,0,?)',
+            (name, json.dumps(configs),
              ManagedJobStatus.PENDING.value, time.time(),
-             recovery_strategy, max_restarts_on_errors))
+             recovery_strategy, max_restarts_on_errors, len(configs)))
         return int(cur.lastrowid)
 
 
@@ -156,6 +174,17 @@ def set_cluster(job_id: int, cluster_name: str,
         (cluster_name, cluster_job_id, job_id))
 
 
+def advance_task(job_id: int, next_index: int) -> None:
+    """Move a pipeline job to its next task: clears the finished task's
+    cluster binding and the per-task restart counter (each task gets its
+    own max_restarts_on_errors budget, like the reference's per-task
+    strategy executors)."""
+    db_utils.execute(
+        _ensure(), 'UPDATE managed_jobs SET task_index=?, '
+        'cluster_name=NULL, cluster_job_id=NULL, restarts_on_errors=0 '
+        'WHERE job_id=?', (next_index, job_id))
+
+
 def bump_recovery_count(job_id: int) -> int:
     path = _ensure()
     with db_utils.transaction(path) as conn:
@@ -203,10 +232,18 @@ def nonterminal_jobs() -> List[Dict[str, Any]]:
 
 
 def _row(row) -> Dict[str, Any]:
+    raw = json.loads(row['task_config'] or '{}')
+    # Pre-pipeline rows stored a bare dict; canonical form is a list.
+    task_configs = raw if isinstance(raw, list) else [raw]
+    task_index = min(row['task_index'] or 0, len(task_configs) - 1)
     return {
         'job_id': row['job_id'],
         'name': row['name'],
-        'task_config': json.loads(row['task_config'] or '{}'),
+        'task_configs': task_configs,
+        'task_index': row['task_index'] or 0,
+        'num_tasks': row['num_tasks'] or len(task_configs),
+        # The *current* task's config (what the controller is running).
+        'task_config': task_configs[task_index],
         'status': ManagedJobStatus(row['status']),
         'cluster_name': row['cluster_name'],
         'cluster_job_id': row['cluster_job_id'],

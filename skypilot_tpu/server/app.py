@@ -259,12 +259,24 @@ def make_app() -> web.Application:
     # ----- managed jobs (controllers run consolidated in this process) -------
     async def jobs_launch(request):
         body = await _json_body(request, 'jobs_launch')
-        task = task_lib.Task.from_yaml_config(body['task'])
+        if 'tasks' in body:
+            # Pipeline: a chain Dag of tasks run sequentially.
+            from skypilot_tpu import dag as dag_lib
+            payload = dag_lib.Dag(name=body.get('name'))
+            prev = None
+            for cfg in body['tasks']:
+                t = task_lib.Task.from_yaml_config(cfg)
+                payload.add(t)
+                if prev is not None:
+                    payload.add_edge(prev, t)
+                prev = t
+        else:
+            payload = task_lib.Task.from_yaml_config(body['task'])
         name = body.get('name')
 
         def work():
             from skypilot_tpu import jobs as jobs_lib
-            return {'job_id': jobs_lib.launch(task, name)}
+            return {'job_id': jobs_lib.launch(payload, name)}
 
         request_id = request.app['executor'].submit(
             'jobs_launch', body, work, long=False)

@@ -58,8 +58,14 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
     },
     'jobs_launch': {
         'type': 'object',
-        'required': ['task'],
-        'properties': {'task': _TASK, 'name': {'type': ['string', 'null']}},
+        # Either a single task or a pipeline (list of tasks run as a
+        # chain, sky/jobs/controller.py:98).
+        'anyOf': [{'required': ['task']}, {'required': ['tasks']}],
+        'properties': {
+            'task': _TASK,
+            'tasks': {'type': 'array', 'items': _TASK, 'minItems': 1},
+            'name': {'type': ['string', 'null']},
+        },
         'additionalProperties': False,
     },
     'jobs_cancel': {

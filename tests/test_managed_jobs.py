@@ -132,6 +132,101 @@ def test_managed_job_queue_lists_jobs(jobs_env):
                r['status'] is ManagedJobStatus.SUCCEEDED for r in q)
 
 
+def test_pipeline_runs_tasks_in_order(jobs_env, tmp_home):
+    """Chain-dag managed job (parity: the reference controller iterates
+    dag tasks, sky/jobs/controller.py:98): tasks run sequentially, each
+    on its own ephemeral cluster, and the whole job succeeds."""
+    from skypilot_tpu import dag as dag_lib
+    log = tmp_home / 'order.txt'
+    t1 = _local_task(f'echo one >> {log}', name='stage-one')
+    t2 = _local_task(f'echo two >> {log}', name='stage-two')
+    dag = dag_lib.Dag('pipe')
+    dag.add_edge(t1, t2)
+    job_id = jobs.launch(dag)
+    final = controller_lib.wait_job(job_id, timeout_s=90)
+    assert final is ManagedJobStatus.SUCCEEDED
+    assert log.read_text().split() == ['one', 'two']
+    rec = jobs_state.get(job_id)
+    assert rec['num_tasks'] == 2 and rec['task_index'] == 1
+    # Both per-task clusters torn down.
+    for idx, t in enumerate((t1, t2)):
+        name = controller_lib.cluster_name_for_job(job_id, t.name, idx, 2)
+        assert global_user_state.get_cluster(name) is None
+
+
+def test_pipeline_recovers_current_task_only(jobs_env, tmp_home):
+    """Preemption during task 2 recovers task 2; task 1 never re-runs."""
+    log = tmp_home / 'runs.txt'
+    gate = tmp_home / 'gate'
+    t1 = _local_task(f'echo first >> {log}', name='one')
+    run2 = f'''
+echo second >> {log}
+while [ ! -f {gate} ]; do sleep 0.1; done
+echo done-two'''
+    t2 = _local_task(run2, name='two')
+    job_id = jobs.launch(_chain(t1, t2))
+    # Wait for task 2's cluster to be running (task_index advanced).
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        rec = jobs_state.get(job_id)
+        if rec['task_index'] == 1 and \
+                rec['status'] is ManagedJobStatus.RUNNING and \
+                log.exists() and 'second' in log.read_text():
+            break
+        time.sleep(0.1)
+    rec = jobs_state.get(job_id)
+    assert rec['task_index'] == 1, rec['status']
+    from skypilot_tpu.provision.local import instance as local_instance
+    local_instance.inject_preemption(rec['cluster_name'])
+    _wait_status(job_id, (ManagedJobStatus.RECOVERING,), timeout=20)
+    gate.write_text('go')
+    final = controller_lib.wait_job(job_id, timeout_s=90)
+    assert final is ManagedJobStatus.SUCCEEDED
+    runs = log.read_text().split()
+    assert runs.count('first') == 1     # task 1 never re-ran
+    assert runs.count('second') >= 2    # task 2 re-ran after recovery
+    assert jobs_state.get(job_id)['recovery_count'] >= 1
+
+
+def _chain(*tasks):
+    from skypilot_tpu import dag as dag_lib
+    dag = dag_lib.Dag('pipe')
+    prev = None
+    for t in tasks:
+        dag.add(t)
+        if prev is not None:
+            dag.add_edge(prev, t)
+        prev = t
+    return dag
+
+
+def test_pipeline_fails_fast_on_task_failure(jobs_env, tmp_home):
+    log = tmp_home / 'fail.txt'
+    t1 = _local_task('exit 3', name='bad')
+    t2 = _local_task(f'echo never >> {log}', name='after')
+    job_id = jobs.launch(_chain(t1, t2))
+    final = controller_lib.wait_job(job_id, timeout_s=90)
+    assert final is ManagedJobStatus.FAILED
+    assert not log.exists()             # downstream task never ran
+    assert jobs_state.get(job_id)['task_index'] == 0
+
+
+def test_failed_setup_is_immediately_terminal(jobs_env, tmp_home):
+    """Setup failure is deterministic: terminal on first occurrence even
+    with a restart budget (reference: should_restart_on_failure)."""
+    marker = tmp_home / 'setup-attempts.txt'
+    t = _local_task('echo unreachable', name='badsetup')
+    t.setup = f'echo x >> {marker}; exit 9'
+    t.set_resources(Resources.from_yaml_config(
+        {'infra': 'local',
+         'job_recovery': {'strategy': 'FAILOVER',
+                          'max_restarts_on_errors': 3}}))
+    job_id = jobs.launch(t)
+    final = controller_lib.wait_job(job_id, timeout_s=90)
+    assert final is ManagedJobStatus.FAILED_SETUP
+    assert len(marker.read_text().splitlines()) == 1   # no retry
+
+
 def test_state_guards(tmp_home):
     # direct state-machine checks (no clusters involved)
     jid = jobs_state.submit('g', {'run': 'true'})
