@@ -1,0 +1,94 @@
+"""Admin policies: organization-wide hooks that validate/mutate every
+user request before it reaches the orchestrator (parity:
+sky/admin_policy.py AdminPolicy/UserRequest/MutatedUserRequest).
+
+Deployments point ``admin_policy: my_module.MyPolicy`` in the layered
+config at a class implementing ``validate_and_mutate``; the hook runs at
+every task submission chokepoint (execution.launch/exec, managed-jobs
+launch, serve up).  Policies enforce things like "all jobs must use
+spot", "inject the team's billing labels", or "block accelerators above
+v5p" — and can reject a request outright by raising
+``exceptions.UserRequestRejectedByPolicy``.
+"""
+from __future__ import annotations
+
+import dataclasses
+import importlib
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+
+@dataclasses.dataclass
+class RequestOptions:
+    """Context the policy sees alongside the task."""
+    operation: str                      # 'launch' | 'exec' | 'jobs' | 'serve'
+    cluster_name: Optional[str] = None
+    dryrun: bool = False
+
+
+@dataclasses.dataclass
+class UserRequest:
+    task: Any                           # task_lib.Task
+    request_options: RequestOptions
+    config: Optional[Dict[str, Any]] = None
+
+
+@dataclasses.dataclass
+class MutatedUserRequest:
+    task: Any
+
+
+class AdminPolicy:
+    """Subclass and override; referenced from config by dotted path."""
+
+    def validate_and_mutate(self,
+                            user_request: UserRequest
+                            ) -> MutatedUserRequest:
+        raise NotImplementedError
+
+
+def _load_policy() -> Optional[AdminPolicy]:
+    from skypilot_tpu import sky_config
+    path = sky_config.get_nested(('admin_policy',), None)
+    if not path:
+        return None
+    module_name, _, class_name = str(path).rpartition('.')
+    if not module_name:
+        raise exceptions.InvalidSkyConfigError(
+            f'admin_policy must be a dotted path module.Class, '
+            f'got {path!r}')
+    try:
+        cls = getattr(importlib.import_module(module_name), class_name)
+    except (ImportError, AttributeError) as e:
+        raise exceptions.InvalidSkyConfigError(
+            f'cannot load admin_policy {path!r}: {e}') from e
+    if not (isinstance(cls, type) and issubclass(cls, AdminPolicy)):
+        raise exceptions.InvalidSkyConfigError(
+            f'admin_policy {path!r} is not an AdminPolicy subclass')
+    return cls()
+
+
+def apply(task, operation: str, cluster_name: Optional[str] = None,
+          dryrun: bool = False):
+    """Run the configured policy over one task; returns the (possibly
+    mutated) task.  No-op when no policy is configured."""
+    policy = _load_policy()
+    if policy is None:
+        return task
+    request = UserRequest(task=task,
+                          request_options=RequestOptions(
+                              operation=operation,
+                              cluster_name=cluster_name,
+                              dryrun=dryrun))
+    mutated = policy.validate_and_mutate(request)
+    if not isinstance(mutated, MutatedUserRequest):
+        raise exceptions.InvalidSkyConfigError(
+            f'admin policy {type(policy).__name__} must return a '
+            f'MutatedUserRequest, got {type(mutated).__name__}')
+    logger.debug(f'admin policy {type(policy).__name__} applied to '
+                 f'{operation} request')
+    return mutated.task

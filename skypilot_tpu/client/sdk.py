@@ -16,12 +16,38 @@ import requests as requests_lib
 
 from skypilot_tpu import exceptions
 from skypilot_tpu import task as task_lib
+from skypilot_tpu.server.constants import (API_VERSION,
+                                           API_VERSION_HEADER,
+                                           MIN_COMPATIBLE_API_VERSION)
 
 DEFAULT_SERVER = 'http://127.0.0.1:8700'
 
 
 def server_url() -> str:
     return os.environ.get('SKYTPU_API_SERVER', DEFAULT_SERVER).rstrip('/')
+
+
+def request_headers() -> Dict[str, str]:
+    """Auth + API-version headers on every SDK call (shared with the
+    async SDK)."""
+    from skypilot_tpu.utils import auth
+    headers = {API_VERSION_HEADER: str(API_VERSION)}
+    token = auth.get_auth_token()
+    if token:
+        headers['Authorization'] = f'Bearer {token}'
+    return headers
+
+
+def check_server_compat(info: Dict[str, Any]) -> None:
+    """Two-way handshake: refuse servers older than this client still
+    understands (the server rejects too-old clients with 426)."""
+    server_version = info.get('api_version')
+    if server_version is not None and \
+            int(server_version) < MIN_COMPATIBLE_API_VERSION:
+        raise exceptions.ApiVersionMismatchError(
+            f'API server {server_url()} speaks version {server_version}, '
+            f'older than the oldest this client supports '
+            f'({MIN_COMPATIBLE_API_VERSION}); upgrade the server.')
 
 
 def api_info(timeout: float = 2.0) -> Optional[Dict[str, Any]]:
@@ -34,7 +60,9 @@ def api_info(timeout: float = 2.0) -> Optional[Dict[str, Any]]:
 
 
 def ensure_server_running(timeout_s: float = 30.0) -> None:
-    if api_info() is not None:
+    info = api_info()
+    if info is not None:
+        check_server_compat(info)
         return
     url = server_url()
     if '127.0.0.1' not in url and 'localhost' not in url:
@@ -54,7 +82,9 @@ def ensure_server_running(timeout_s: float = 30.0) -> None:
         start_new_session=True)
     deadline = time.time() + timeout_s
     while time.time() < deadline:
-        if api_info() is not None:
+        info = api_info()
+        if info is not None:
+            check_server_compat(info)
             return
         time.sleep(0.5)
     raise exceptions.ApiServerError('API server failed to start.')
@@ -63,7 +93,7 @@ def ensure_server_running(timeout_s: float = 30.0) -> None:
 def _post(path: str, body: Dict[str, Any]) -> Dict[str, Any]:
     ensure_server_running()
     resp = requests_lib.post(f'{server_url()}{path}', json=body,
-                             timeout=60)
+                             headers=request_headers(), timeout=60)
     if resp.status_code >= 400:
         raise exceptions.ApiServerError(
             f'{path} failed ({resp.status_code}): {resp.text}')
@@ -73,7 +103,7 @@ def _post(path: str, body: Dict[str, Any]) -> Dict[str, Any]:
 def _get(path: str, **params) -> Any:
     ensure_server_running()
     resp = requests_lib.get(f'{server_url()}{path}', params=params,
-                            timeout=60)
+                            headers=request_headers(), timeout=60)
     if resp.status_code >= 400:
         raise exceptions.ApiServerError(
             f'{path} failed ({resp.status_code}): {resp.text}')
@@ -157,7 +187,10 @@ def tail_logs(cluster_name: str, job_id: int, follow: bool = True,
     resp = requests_lib.get(
         f'{server_url()}/logs/{cluster_name}/{job_id}',
         params={'follow': '1' if follow else '0'}, stream=True,
-        timeout=None)
+        headers=request_headers(), timeout=None)
+    if resp.status_code >= 400:
+        raise exceptions.ApiServerError(
+            f'logs failed ({resp.status_code}): {resp.text}')
     for chunk in resp.iter_content(chunk_size=None):
         out.write(chunk.decode(errors='replace'))
         out.flush()
@@ -190,7 +223,7 @@ def jobs_tail_logs(job_id: int, follow: bool = True, out=None) -> None:
     resp = requests_lib.get(
         f'{server_url()}/jobs/logs/{job_id}',
         params={'follow': '1' if follow else '0'}, stream=True,
-        timeout=None)
+        headers=request_headers(), timeout=None)
     if resp.status_code >= 400:
         raise exceptions.ApiServerError(
             f'jobs logs failed ({resp.status_code}): {resp.text}')
@@ -226,7 +259,7 @@ def serve_replica_logs(service_name: str, replica_id: int,
     resp = requests_lib.get(
         f'{server_url()}/serve/logs/{service_name}/{replica_id}',
         params={'follow': '1' if follow else '0'}, stream=True,
-        timeout=None)
+        headers=request_headers(), timeout=None)
     if resp.status_code >= 400:
         raise exceptions.ApiServerError(
             f'serve logs failed ({resp.status_code}): {resp.text}')

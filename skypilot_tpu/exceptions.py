@@ -38,6 +38,11 @@ class InvalidSkyConfigError(SkyTpuError):
     """Layered config file failed schema validation."""
 
 
+class UserRequestRejectedByPolicy(SkyTpuError):
+    """The configured admin policy rejected this request
+    (parity: sky/exceptions.py UserRequestRejectedByPolicy)."""
+
+
 class InvalidDagError(SkyTpuError):
     """DAG has cycles or otherwise cannot be scheduled."""
 

@@ -44,6 +44,7 @@ def launch(
     quiet_optimizer: bool = False,
     blocked_resources: Optional[list] = None,
     retry_until_up: bool = False,
+    policy_operation: str = 'launch',
 ) -> Tuple[Optional[int], Optional[ClusterHandle]]:
     """Provision (or reuse) a cluster and run the task on it.
 
@@ -55,6 +56,13 @@ def launch(
     """
     cluster_name = cluster_name or f'sky-{common_utils.generate_id()}'
     common_utils.validate_cluster_name(cluster_name)
+    # Org-wide admin policy hook (validate/mutate/reject); runs at this
+    # chokepoint so CLI, SDK, managed jobs, and serve replicas are all
+    # covered (including relaunches during jobs recovery — policies are
+    # expected to be idempotent).
+    from skypilot_tpu import admin_policy
+    task = admin_policy.apply(task, policy_operation,
+                              cluster_name=cluster_name, dryrun=dryrun)
     stages = stages or list(Stage)
     backend = TpuVmBackend()
     from skypilot_tpu.utils import timeline
@@ -132,6 +140,7 @@ def exec_(
             f'Cluster {cluster_name!r} is {record["status"].value}.')
     stages = [Stage.SYNC_WORKDIR, Stage.EXEC]
     job_id, handle = launch(task, cluster_name, stages=stages,
-                            detach_run=detach_run)
+                            detach_run=detach_run,
+                            policy_operation='exec')
     assert handle is not None
     return job_id, handle

@@ -81,7 +81,8 @@ class StrategyExecutor:
         """Provision (with failover) + run; returns the cluster job id."""
         job_id, _ = execution.launch(
             self.task, self.cluster_name, detach_run=True,
-            quiet_optimizer=True, blocked_resources=self._blocked or None)
+            quiet_optimizer=True, blocked_resources=self._blocked or None,
+            policy_operation='jobs')
         assert job_id is not None
         return job_id
 

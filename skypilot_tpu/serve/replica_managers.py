@@ -142,7 +142,8 @@ class ReplicaManager:
         try:
             task = self._replica_task(replica_id, port, zone, is_spot)
             job_id, handle = execution.launch(
-                task, cluster, detach_run=True, quiet_optimizer=True)
+                task, cluster, detach_run=True, quiet_optimizer=True,
+                policy_operation='serve')
             url = f'http://{handle.head_ip}:{port}'
             serve_state.set_replica_endpoint(self.service_name, replica_id,
                                              url, job_id)

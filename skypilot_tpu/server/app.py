@@ -32,10 +32,12 @@ from skypilot_tpu import sky_logging
 from skypilot_tpu import task as task_lib
 from skypilot_tpu.server import payloads
 from skypilot_tpu.server import requests_db
+from skypilot_tpu.server.constants import (API_VERSION,
+                                           API_VERSION_HEADER,
+                                           MIN_COMPATIBLE_API_VERSION)
 from skypilot_tpu.server.executor import RequestExecutor
 
 logger = sky_logging.init_logger(__name__)
-API_VERSION = 1
 
 
 def _record_json(record: Dict[str, Any]) -> Dict[str, Any]:
@@ -46,11 +48,8 @@ def _record_json(record: Dict[str, Any]) -> Dict[str, Any]:
 
 
 def _auth_token() -> Optional[str]:
-    token = os.environ.get('SKYTPU_API_TOKEN')
-    if token:
-        return token
-    from skypilot_tpu import sky_config
-    return sky_config.get_nested(('api_server', 'auth_token'), None)
+    from skypilot_tpu.utils import auth
+    return auth.get_auth_token()
 
 
 async def _json_body(request, schema_name: str) -> Dict[str, Any]:
@@ -74,6 +73,8 @@ async def _error_middleware(request, handler):
         return web.json_response({'error': str(e)}, status=400)
     except exceptions.InvalidTaskError as e:
         return web.json_response({'error': str(e)}, status=400)
+    except exceptions.UserRequestRejectedByPolicy as e:
+        return web.json_response({'error': str(e)}, status=403)
     except Exception as e:  # pylint: disable=broad-except
         logger.exception(f'unhandled error on {request.path}')
         return web.json_response(
@@ -91,8 +92,37 @@ async def _auth_middleware(request, handler):
     return await handler(request)
 
 
+@web.middleware
+async def _version_middleware(request, handler):
+    """Reject clients older than this server still understands with 426
+    Upgrade Required (parity: the reference's client/server API-version
+    handshake, sky/server/constants.py).  Clients that send no version
+    header are allowed (curl, probes); /api/health always answers so an
+    old client can at least learn the server's versions."""
+    header = request.headers.get(API_VERSION_HEADER)
+    if header is not None and request.path != '/api/health':
+        try:
+            client_version = int(header)
+        except ValueError:
+            return web.json_response(
+                {'error': f'invalid {API_VERSION_HEADER}: {header!r}'},
+                status=400)
+        if client_version < MIN_COMPATIBLE_API_VERSION:
+            return web.json_response(
+                {'error': f'client API version {client_version} is '
+                          f'older than the oldest this server supports '
+                          f'({MIN_COMPATIBLE_API_VERSION}); upgrade the '
+                          f'client',
+                 'api_version': API_VERSION,
+                 'min_compatible_api_version':
+                     MIN_COMPATIBLE_API_VERSION},
+                status=426)
+    return await handler(request)
+
+
 def make_app() -> web.Application:
     app = web.Application(middlewares=[_auth_middleware,
+                                       _version_middleware,
                                        _error_middleware])
     executor = RequestExecutor()
     app['executor'] = executor
@@ -117,8 +147,11 @@ def make_app() -> web.Application:
 
     # ----- health / meta -----------------------------------------------------
     async def health(request):
-        return web.json_response({'status': 'healthy',
-                                  'api_version': API_VERSION})
+        return web.json_response({
+            'status': 'healthy',
+            'api_version': API_VERSION,
+            'min_compatible_api_version': MIN_COMPATIBLE_API_VERSION,
+        })
 
     async def metrics_route(request):
         from skypilot_tpu.server import metrics as metrics_lib
@@ -145,17 +178,30 @@ def make_app() -> web.Application:
             o, default=str))
 
     # ----- cluster lifecycle (per-request worker processes) ------------------
+    def _apply_policy(body, operation, cluster_name=None):
+        """Admin policy runs inline at the route so a rejection is a
+        403 response, not a FAILED record discovered at poll time; the
+        mutated task replaces the payload before it reaches the worker
+        (execution.launch re-applies as defense in depth — policies are
+        idempotent by contract)."""
+        from skypilot_tpu import admin_policy
+        task = task_lib.Task.from_yaml_config(body['task'])
+        task = admin_policy.apply(task, operation,
+                                  cluster_name=cluster_name,
+                                  dryrun=bool(body.get('dryrun')))
+        body['task'] = task.to_yaml_config()
+
     async def launch(request):
         body = await _json_body(request, 'launch')
         # Validate task construction inline: a bad task is a 400 now, not
         # a FAILED request discovered at poll time.
-        task_lib.Task.from_yaml_config(body['task'])
+        _apply_policy(body, 'launch', body.get('cluster_name'))
         request_id = request.app['executor'].submit_process('launch', body)
         return web.json_response({'request_id': request_id})
 
     async def exec_(request):
         body = await _json_body(request, 'exec')
-        task_lib.Task.from_yaml_config(body['task'])
+        _apply_policy(body, 'exec', body.get('cluster_name'))
         request_id = request.app['executor'].submit_process('exec', body)
         return web.json_response({'request_id': request_id})
 
@@ -259,19 +305,22 @@ def make_app() -> web.Application:
     # ----- managed jobs (controllers run consolidated in this process) -------
     async def jobs_launch(request):
         body = await _json_body(request, 'jobs_launch')
+        from skypilot_tpu import admin_policy
         if 'tasks' in body:
             # Pipeline: a chain Dag of tasks run sequentially.
             from skypilot_tpu import dag as dag_lib
             payload = dag_lib.Dag(name=body.get('name'))
             prev = None
             for cfg in body['tasks']:
-                t = task_lib.Task.from_yaml_config(cfg)
+                t = admin_policy.apply(
+                    task_lib.Task.from_yaml_config(cfg), 'jobs')
                 payload.add(t)
                 if prev is not None:
                     payload.add_edge(prev, t)
                 prev = t
         else:
-            payload = task_lib.Task.from_yaml_config(body['task'])
+            payload = admin_policy.apply(
+                task_lib.Task.from_yaml_config(body['task']), 'jobs')
         name = body.get('name')
 
         def work():
@@ -333,7 +382,9 @@ def make_app() -> web.Application:
     # ----- serve (controllers run consolidated in this process) --------------
     async def serve_up(request):
         body = await _json_body(request, 'serve_up')
-        task = task_lib.Task.from_yaml_config(body['task'])
+        from skypilot_tpu import admin_policy
+        task = admin_policy.apply(
+            task_lib.Task.from_yaml_config(body['task']), 'serve')
         name = body.get('name')
 
         def work():

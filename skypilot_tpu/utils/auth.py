@@ -1,0 +1,16 @@
+"""Shared client/server auth-token lookup: env SKYTPU_API_TOKEN, then
+api_server.auth_token in the layered config.  One helper so the server
+middleware and both SDKs can never drift on where the token comes from.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def get_auth_token() -> Optional[str]:
+    token = os.environ.get('SKYTPU_API_TOKEN')
+    if token:
+        return token
+    from skypilot_tpu import sky_config
+    return sky_config.get_nested(('api_server', 'auth_token'), None)

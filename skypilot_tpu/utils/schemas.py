@@ -131,6 +131,7 @@ CONFIG_SCHEMA: Dict[str, Any] = {
             'properties': {
                 'endpoint': {'type': 'string'},
                 'workers': {'type': 'integer'},
+                'auth_token': {'type': 'string'},
             },
         },
         'gcp': {
