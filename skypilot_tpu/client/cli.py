@@ -113,9 +113,12 @@ def exec_cmd(entrypoint, cluster, detach_run, **overrides):
 @cli.command()
 @click.argument('clusters', nargs=-1)
 @click.option('--refresh', '-r', is_flag=True, default=False)
-def status(clusters, refresh):
-    """Show clusters."""
-    records = sdk.status(list(clusters) or None, refresh=refresh)
+@click.option('--all-users', '-u', is_flag=True, default=False,
+              help='Show all users\' clusters, not just yours.')
+def status(clusters, refresh, all_users):
+    """Show clusters (in the active workspace)."""
+    records = sdk.status(list(clusters) or None, refresh=refresh,
+                         all_users=all_users)
     rows = []
     for r in records:
         res = r.get('resources', {})
@@ -123,11 +126,12 @@ def status(clusters, refresh):
             r['name'], r['status'],
             res.get('accelerators') or res.get('instance_type') or 'cpu',
             res.get('infra', '-'),
+            r.get('user_name') or '-',
             common_utils.readable_time_duration(
                 max(0, __import__('time').time() - r['launched_at'])),
         ])
-    ux_utils.print_table(['NAME', 'STATUS', 'RESOURCES', 'INFRA', 'AGE'],
-                         rows)
+    ux_utils.print_table(['NAME', 'STATUS', 'RESOURCES', 'INFRA', 'USER',
+                          'AGE'], rows)
 
 
 @cli.command()

@@ -28,13 +28,20 @@ def server_url() -> str:
 
 
 def request_headers() -> Dict[str, str]:
-    """Auth + API-version headers on every SDK call (shared with the
-    async SDK)."""
+    """Auth + API-version + identity headers on every SDK call (shared
+    with the async SDK).  The server acts as this user in this
+    workspace (skypilot_tpu/users.py, workspaces.py)."""
+    from skypilot_tpu import users as users_lib
+    from skypilot_tpu import workspaces as workspaces_lib
+    from skypilot_tpu.server.constants import (USER_HEADER,
+                                               WORKSPACE_HEADER)
     from skypilot_tpu.utils import auth
     headers = {API_VERSION_HEADER: str(API_VERSION)}
     token = auth.get_auth_token()
     if token:
         headers['Authorization'] = f'Bearer {token}'
+    headers[USER_HEADER] = users_lib.current_user().name
+    headers[WORKSPACE_HEADER] = workspaces_lib.active_workspace()
     return headers
 
 
@@ -144,8 +151,10 @@ def exec_(task: task_lib.Task, cluster_name: str) -> str:
 
 
 def status(cluster_names: Optional[List[str]] = None,
-           refresh: bool = False) -> List[Dict[str, Any]]:
-    params: Dict[str, Any] = {'refresh': '1' if refresh else '0'}
+           refresh: bool = False,
+           all_users: bool = False) -> List[Dict[str, Any]]:
+    params: Dict[str, Any] = {'refresh': '1' if refresh else '0',
+                              'all_users': '1' if all_users else '0'}
     if cluster_names:
         params['cluster'] = cluster_names
     return _get('/status', **params)
@@ -209,8 +218,8 @@ def jobs_launch(task_or_tasks, name: Optional[str] = None) -> str:
     return _post('/jobs/launch', body)['request_id']
 
 
-def jobs_queue() -> List[Dict[str, Any]]:
-    return _get('/jobs/queue')
+def jobs_queue(all_users: bool = False) -> List[Dict[str, Any]]:
+    return _get('/jobs/queue', all_users='1' if all_users else '0')
 
 
 def jobs_cancel(job_id: int) -> bool:

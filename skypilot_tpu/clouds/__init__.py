@@ -37,14 +37,27 @@ def enabled_clouds(reload: bool = False) -> List[Cloud]:
     import os
     override = os.environ.get('SKYTPU_ENABLED_CLOUDS')
     if override is not None:
-        return [get_cloud(n) for n in override.split(',') if n.strip()]
-    global _enabled_cache
-    if _enabled_cache is None or reload:
-        _enabled_cache = [
-            cloud for cloud in CLOUD_REGISTRY.values()
-            if cloud.check_credentials()[0]
-        ]
-    return list(_enabled_cache)
+        clouds = [get_cloud(n) for n in override.split(',') if n.strip()]
+    else:
+        global _enabled_cache
+        if _enabled_cache is None or reload:
+            _enabled_cache = [
+                cloud for cloud in CLOUD_REGISTRY.values()
+                if cloud.check_credentials()[0]
+            ]
+        clouds = list(_enabled_cache)
+    # Config restrictions compose: global `allowed_clouds`, then the
+    # active workspace's `allowed_clouds` (skypilot_tpu/workspaces.py).
+    from skypilot_tpu import sky_config
+    from skypilot_tpu import workspaces
+    global_allowed = sky_config.get_nested(('allowed_clouds',), None)
+    if global_allowed:
+        global_allowed = [str(c).lower() for c in global_allowed]
+    for restriction in (global_allowed, workspaces.allowed_clouds()):
+        if restriction:
+            clouds = [c for c in clouds
+                      if c.NAME.lower() in restriction]
+    return clouds
 
 
 def cloud_in_iterable(cloud: Cloud, clouds) -> bool:

@@ -7,36 +7,52 @@ from typing import Any, Dict, List, Optional
 from skypilot_tpu import clouds as clouds_lib
 from skypilot_tpu import exceptions
 from skypilot_tpu import global_user_state
+from skypilot_tpu import users as users_lib
+from skypilot_tpu import workspaces as workspaces_lib
 from skypilot_tpu.backends import TpuVmBackend
 from skypilot_tpu.backends import backend_utils
 from skypilot_tpu.global_user_state import ClusterStatus
 
 
 def status(cluster_names: Optional[List[str]] = None,
-           refresh: bool = False) -> List[Dict[str, Any]]:
+           refresh: bool = False,
+           all_users: bool = False) -> List[Dict[str, Any]]:
+    """Clusters in the active workspace; the caller's own by default
+    (parity: `sky status` filters by user, `-u` shows everyone)."""
     if refresh:
-        return backend_utils.refresh_all(cluster_names)
-    records = global_user_state.get_clusters()
+        records = backend_utils.refresh_all(cluster_names)
+    else:
+        records = global_user_state.get_clusters()
+    records = [r for r in records if workspaces_lib.visible(r)]
+    if not all_users:
+        me = users_lib.current_user().name
+        records = [r for r in records
+                   if r.get('user_name') in (None, me)]
     if cluster_names:
         records = [r for r in records if r['name'] in cluster_names]
     return records
 
 
-def _get_handle(cluster_name: str):
+def _get_handle(cluster_name: str, op: Optional[str] = None):
+    """Look up a cluster, enforcing workspace isolation (a cluster in
+    another workspace is indistinguishable from absent) and, for
+    mutating ops (`op` given), RBAC ownership."""
     record = global_user_state.get_cluster(cluster_name)
-    if record is None:
+    if record is None or not workspaces_lib.visible(record):
         raise exceptions.ClusterDoesNotExistError(
             f'Cluster {cluster_name!r} does not exist.')
+    if op is not None:
+        users_lib.check_cluster_op(record, op)
     return record
 
 
 def down(cluster_name: str) -> None:
-    record = _get_handle(cluster_name)
+    record = _get_handle(cluster_name, op='down')
     TpuVmBackend().teardown(record['handle'], terminate=True)
 
 
 def stop(cluster_name: str) -> None:
-    record = _get_handle(cluster_name)
+    record = _get_handle(cluster_name, op='stop')
     res = record['handle'].launched_resources()
     clouds_lib.get_cloud(record['handle'].cloud).check_capability(
         clouds_lib.CloudCapability.STOP, res)
@@ -45,7 +61,7 @@ def stop(cluster_name: str) -> None:
 
 def start(cluster_name: str) -> None:
     """Restart a STOPPED cluster on its original placement."""
-    record = _get_handle(cluster_name)
+    record = _get_handle(cluster_name, op='start')
     if record['status'] is ClusterStatus.UP:
         return
     from skypilot_tpu import task as task_lib
@@ -61,7 +77,7 @@ def start(cluster_name: str) -> None:
 
 def autostop(cluster_name: str, idle_minutes: int,
              down_flag: bool = False) -> None:
-    record = _get_handle(cluster_name)
+    record = _get_handle(cluster_name, op='autostop')
     handle = record['handle']
     res = handle.launched_resources()
     if not down_flag:
@@ -83,7 +99,7 @@ def queue(cluster_name: str) -> List[Dict[str, Any]]:
 
 
 def cancel(cluster_name: str, job_id: int) -> bool:
-    record = _get_handle(cluster_name)
+    record = _get_handle(cluster_name, op='cancel')
     return TpuVmBackend().cancel_job(record['handle'], job_id)
 
 
@@ -92,11 +108,18 @@ def tail_logs(cluster_name: str, job_id: int, follow: bool = True) -> int:
     return TpuVmBackend().tail_logs(record['handle'], job_id, follow=follow)
 
 
-def cost_report() -> List[Dict[str, Any]]:
-    """Rough accrued cost per live cluster (reference: sky/core.py:375)."""
+def cost_report(all_users: bool = False) -> List[Dict[str, Any]]:
+    """Rough accrued cost per live cluster (reference: sky/core.py:375).
+    Scoped like status(): the active workspace, the caller's clusters
+    unless all_users."""
     import time
     out = []
-    for rec in global_user_state.get_clusters():
+    records = [r for r in global_user_state.get_clusters()
+               if workspaces_lib.visible(r)]
+    if not all_users:
+        me = users_lib.current_user().name
+        records = [r for r in records if r.get('user_name') in (None, me)]
+    for rec in records:
         res = rec['handle'].launched_resources()
         try:
             from skypilot_tpu import catalog

@@ -43,6 +43,11 @@ class UserRequestRejectedByPolicy(SkyTpuError):
     (parity: sky/exceptions.py UserRequestRejectedByPolicy)."""
 
 
+class PermissionDeniedError(SkyTpuError):
+    """RBAC: the acting user's role does not allow this operation
+    (parity: sky/users/permission.py checks)."""
+
+
 class InvalidDagError(SkyTpuError):
     """DAG has cycles or otherwise cannot be scheduled."""
 

@@ -63,6 +63,19 @@ def launch(
     from skypilot_tpu import admin_policy
     task = admin_policy.apply(task, policy_operation,
                               cluster_name=cluster_name, dryrun=dryrun)
+    # Workspace + RBAC guards: the active workspace must be a configured
+    # one, and reusing an existing cluster name must not hijack another
+    # workspace's or (for non-admins) another user's cluster.
+    from skypilot_tpu import users as users_lib
+    from skypilot_tpu import workspaces as workspaces_lib
+    workspaces_lib.validate_active()
+    existing = global_user_state.get_cluster(cluster_name)
+    if existing is not None:
+        if not workspaces_lib.visible(existing):
+            raise exceptions.PermissionDeniedError(
+                f'cluster name {cluster_name!r} is in use in another '
+                f'workspace')
+        users_lib.check_cluster_op(existing, policy_operation)
     stages = stages or list(Stage)
     backend = TpuVmBackend()
     from skypilot_tpu.utils import timeline
@@ -131,8 +144,11 @@ def exec_(
 ) -> Tuple[Optional[int], ClusterHandle]:
     """Run on an existing cluster, skipping provision/setup
     (reference: sky/execution.py:736)."""
+    from skypilot_tpu import workspaces as workspaces_lib
     record = global_user_state.get_cluster(cluster_name)
-    if record is None:
+    if record is None or not workspaces_lib.visible(record):
+        # A cluster in another workspace is indistinguishable from
+        # absent — do not leak its existence or status.
         raise exceptions.ClusterDoesNotExistError(
             f'Cluster {cluster_name!r} does not exist; launch it first.')
     if record['status'] is not ClusterStatus.UP:

@@ -45,8 +45,14 @@ _DDL = [
         owner TEXT,
         handle TEXT,
         resources TEXT,
-        status_updated_at INTEGER
+        status_updated_at INTEGER,
+        user_name TEXT,
+        workspace TEXT
     )""",
+    # Idempotent migrations for DBs predating users/workspaces
+    # (ensure_schema swallows duplicate-column errors).
+    "ALTER TABLE clusters ADD COLUMN user_name TEXT",
+    "ALTER TABLE clusters ADD COLUMN workspace TEXT",
     """CREATE TABLE IF NOT EXISTS cluster_events (
         cluster_name TEXT,
         timestamp INTEGER,
@@ -119,13 +125,16 @@ def add_or_update_cluster(name: str,
                                   'SELECT name FROM clusters WHERE name=?',
                                   (name,))
     if existing is None:
+        from skypilot_tpu import users
+        from skypilot_tpu import workspaces
         db_utils.execute(
             path, 'INSERT INTO clusters (name, launched_at, last_use, '
-            'status, owner, handle, resources, status_updated_at) '
-            'VALUES (?,?,?,?,?,?,?,?)',
+            'status, owner, handle, resources, status_updated_at, '
+            'user_name, workspace) VALUES (?,?,?,?,?,?,?,?,?,?)',
             (name, now, ' '.join(os.sys.argv[:2]), status.value,
              common_utils.get_user_hash(), handle.to_json(),
-             json.dumps(handle.resources_config), now))
+             json.dumps(handle.resources_config), now,
+             users.current_user().name, workspaces.active_workspace()))
     else:
         db_utils.execute(
             path, 'UPDATE clusters SET status=?, handle=?, resources=?, '
@@ -178,6 +187,8 @@ def _row_to_record(row) -> Dict[str, Any]:
         'handle': ClusterHandle.from_json(row['handle']),
         'resources': json.loads(row['resources'] or '{}'),
         'status_updated_at': row['status_updated_at'],
+        'user_name': row['user_name'],
+        'workspace': row['workspace'],
     }
 
 

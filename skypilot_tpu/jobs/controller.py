@@ -159,6 +159,16 @@ class JobController:
         rec = state.get(self.job_id)
         if rec is None or rec['status'].is_terminal():
             return
+        # Act as the submitting user in the submitting workspace for the
+        # whole job lifetime, so recovery clusters launched from this
+        # (server-ambient) controller thread are stamped correctly.
+        from skypilot_tpu import users as users_lib
+        from skypilot_tpu import workspaces as workspaces_lib
+        with users_lib.override(rec.get('user_name')), \
+                workspaces_lib.override(rec.get('workspace')):
+            self._run_all_tasks(rec)
+
+    def _run_all_tasks(self, rec: dict) -> None:
         configs = rec['task_configs']
         strategy: Optional[StrategyExecutor] = None
         try:

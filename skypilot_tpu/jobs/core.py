@@ -65,14 +65,32 @@ def launch(task_or_dag, name: Optional[str] = None) -> int:
     return job_id
 
 
-def queue(refresh: bool = False) -> List[Dict[str, Any]]:
+def queue(refresh: bool = False,
+          all_users: bool = False) -> List[Dict[str, Any]]:
     del refresh  # controller threads keep state fresh
-    return state.list_jobs()
+    from skypilot_tpu import users as users_lib
+    from skypilot_tpu import workspaces as workspaces_lib
+    records = [r for r in state.list_jobs()
+               if workspaces_lib.visible(r)]
+    if not all_users:
+        me = users_lib.current_user().name
+        records = [r for r in records
+                   if r.get('user_name') in (None, me)]
+    return records
 
 
 def cancel(job_id: int) -> bool:
     """Request cancellation; the controller cancels the cluster job and
     tears the cluster down."""
+    from skypilot_tpu import users as users_lib
+    from skypilot_tpu import workspaces as workspaces_lib
+    rec = state.get(job_id)
+    if rec is None or not workspaces_lib.visible(rec):
+        return False
+    if rec.get('user_name') is not None:
+        users_lib.check_cluster_op(
+            {'name': f'managed job {job_id}',
+             'user_name': rec['user_name']}, 'jobs cancel')
     ok = state.request_cancel(job_id)
     if ok:
         # Adopt orphaned jobs (e.g. after an API-server restart) so the

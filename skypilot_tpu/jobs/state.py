@@ -77,6 +77,8 @@ _DDL = [
     # (ensure_schema swallows duplicate-column errors).
     "ALTER TABLE managed_jobs ADD COLUMN task_index INTEGER DEFAULT 0",
     "ALTER TABLE managed_jobs ADD COLUMN num_tasks INTEGER DEFAULT 1",
+    "ALTER TABLE managed_jobs ADD COLUMN user_name TEXT",
+    "ALTER TABLE managed_jobs ADD COLUMN workspace TEXT",
 ]
 
 
@@ -109,15 +111,19 @@ def submit(name: Optional[str], task_config, recovery_strategy: str = 'FAILOVER'
                else [task_config])
     if not configs:
         raise ValueError('managed job needs at least one task')
+    from skypilot_tpu import users
+    from skypilot_tpu import workspaces
     path = _ensure()
     with db_utils.transaction(path) as conn:
         cur = conn.execute(
             'INSERT INTO managed_jobs (name, task_config, status, '
             'submitted_at, recovery_strategy, max_restarts_on_errors, '
-            'task_index, num_tasks) VALUES (?,?,?,?,?,?,0,?)',
+            'task_index, num_tasks, user_name, workspace) '
+            'VALUES (?,?,?,?,?,?,0,?,?,?)',
             (name, json.dumps(configs),
              ManagedJobStatus.PENDING.value, time.time(),
-             recovery_strategy, max_restarts_on_errors, len(configs)))
+             recovery_strategy, max_restarts_on_errors, len(configs),
+             users.current_user().name, workspaces.active_workspace()))
         return int(cur.lastrowid)
 
 
@@ -255,4 +261,6 @@ def _row(row) -> Dict[str, Any]:
         'restarts_on_errors': row['restarts_on_errors'],
         'recovery_strategy': row['recovery_strategy'],
         'failure_reason': row['failure_reason'],
+        'user_name': row['user_name'],
+        'workspace': row['workspace'],
     }

@@ -34,7 +34,8 @@ from skypilot_tpu.server import payloads
 from skypilot_tpu.server import requests_db
 from skypilot_tpu.server.constants import (API_VERSION,
                                            API_VERSION_HEADER,
-                                           MIN_COMPATIBLE_API_VERSION)
+                                           MIN_COMPATIBLE_API_VERSION,
+                                           USER_HEADER, WORKSPACE_HEADER)
 from skypilot_tpu.server.executor import RequestExecutor
 
 logger = sky_logging.init_logger(__name__)
@@ -74,6 +75,8 @@ async def _error_middleware(request, handler):
     except exceptions.InvalidTaskError as e:
         return web.json_response({'error': str(e)}, status=400)
     except exceptions.UserRequestRejectedByPolicy as e:
+        return web.json_response({'error': str(e)}, status=403)
+    except exceptions.PermissionDeniedError as e:
         return web.json_response({'error': str(e)}, status=403)
     except Exception as e:  # pylint: disable=broad-except
         logger.exception(f'unhandled error on {request.path}')
@@ -178,30 +181,61 @@ def make_app() -> web.Application:
             o, default=str))
 
     # ----- cluster lifecycle (per-request worker processes) ------------------
-    def _apply_policy(body, operation, cluster_name=None):
+    def _with_identity(request, fn):
+        """Run `fn` as the caller (X-SkyTPU-User / X-SkyTPU-Workspace
+        headers, forwarded by the SDK); header-less requests keep the
+        server's ambient identity.  Used for work running on executor
+        threads, where the route's own context does not follow."""
+        user = request.headers.get(USER_HEADER)
+        workspace = request.headers.get(WORKSPACE_HEADER)
+
+        def wrapped(*args, **kwargs):
+            from skypilot_tpu import users as users_lib
+            from skypilot_tpu import workspaces as workspaces_lib
+            with users_lib.override(user), \
+                    workspaces_lib.override(workspace):
+                return fn(*args, **kwargs)
+        return wrapped
+
+    def _inject_identity(request, body):
+        """Worker processes re-create identity from the payload (they
+        are fresh spawns; thread-local overrides cannot reach them)."""
+        user = request.headers.get(USER_HEADER)
+        workspace = request.headers.get(WORKSPACE_HEADER)
+        if user:
+            body['_user'] = user
+        if workspace:
+            body['_workspace'] = workspace
+
+    def _apply_policy(request, body, operation, cluster_name=None):
         """Admin policy runs inline at the route so a rejection is a
         403 response, not a FAILED record discovered at poll time; the
         mutated task replaces the payload before it reaches the worker
         (execution.launch re-applies as defense in depth — policies are
         idempotent by contract)."""
         from skypilot_tpu import admin_policy
-        task = task_lib.Task.from_yaml_config(body['task'])
-        task = admin_policy.apply(task, operation,
-                                  cluster_name=cluster_name,
-                                  dryrun=bool(body.get('dryrun')))
-        body['task'] = task.to_yaml_config()
+
+        def run():
+            task = task_lib.Task.from_yaml_config(body['task'])
+            task = admin_policy.apply(task, operation,
+                                      cluster_name=cluster_name,
+                                      dryrun=bool(body.get('dryrun')))
+            body['task'] = task.to_yaml_config()
+        _with_identity(request, run)()
 
     async def launch(request):
         body = await _json_body(request, 'launch')
         # Validate task construction inline: a bad task is a 400 now, not
         # a FAILED request discovered at poll time.
-        _apply_policy(body, 'launch', body.get('cluster_name'))
+        _apply_policy(request, body, 'launch', body.get('cluster_name'))
+        _inject_identity(request, body)
         request_id = request.app['executor'].submit_process('launch', body)
         return web.json_response({'request_id': request_id})
 
     async def exec_(request):
         body = await _json_body(request, 'exec')
-        _apply_policy(body, 'exec', body.get('cluster_name'))
+        _apply_policy(request, body, 'exec', body.get('cluster_name'))
+        _inject_identity(request, body)
         request_id = request.app['executor'].submit_process('exec', body)
         return web.json_response({'request_id': request_id})
 
@@ -217,13 +251,16 @@ def make_app() -> web.Application:
     async def status(request):
         names = request.query.getall('cluster', []) or None
         refresh = request.query.get('refresh', '0') == '1'
+        all_users = request.query.get('all_users', '0') == '1'
         records = await asyncio.get_event_loop().run_in_executor(
-            None, lambda: core.status(names, refresh=refresh))
+            None, _with_identity(request, lambda: core.status(
+                names, refresh=refresh, all_users=all_users)))
         return web.json_response([_record_json(r) for r in records])
 
     def _process_op(name: str):
         async def handler(request):
             body = await _json_body(request, 'cluster_op')
+            _inject_identity(request, body)
             request_id = request.app['executor'].submit_process(name, body)
             return web.json_response({'request_id': request_id})
         return handler
@@ -237,15 +274,16 @@ def make_app() -> web.Application:
         cluster = body['cluster_name']
         request_id = request.app['executor'].submit(
             'autostop', body,
-            lambda: core.autostop(cluster, int(body.get('idle_minutes', 5)),
-                                  bool(body.get('down', False))),
+            _with_identity(request, lambda: core.autostop(
+                cluster, int(body.get('idle_minutes', 5)),
+                bool(body.get('down', False)))),
             long=False)
         return web.json_response({'request_id': request_id})
 
     async def queue(request):
         cluster = request.match_info['cluster_name']
         jobs = await asyncio.get_event_loop().run_in_executor(
-            None, lambda: core.queue(cluster))
+            None, _with_identity(request, lambda: core.queue(cluster)))
         return web.json_response(jobs)
 
     async def cancel(request):
@@ -253,12 +291,17 @@ def make_app() -> web.Application:
         cluster = body['cluster_name']
         job_id = int(body['job_id'])
         ok = await asyncio.get_event_loop().run_in_executor(
-            None, lambda: core.cancel(cluster, job_id))
+            None, _with_identity(request,
+                                 lambda: core.cancel(cluster, job_id)))
         return web.json_response({'cancelled': ok})
 
     async def _stream_cluster_job_logs(request, cluster: str, job_id: int,
                                        follow: bool):
-        record = core._get_handle(cluster)  # pylint: disable=protected-access
+        # Resolve under the caller's identity: workspace isolation must
+        # hold for log reads exactly like every other route.
+        record = _with_identity(
+            request,
+            lambda: core._get_handle(cluster))()  # pylint: disable=protected-access
         from skypilot_tpu.backends import TpuVmBackend
         backend = TpuVmBackend()
         client = backend._agent_client(record['handle'])  # pylint: disable=protected-access
@@ -305,22 +348,26 @@ def make_app() -> web.Application:
     # ----- managed jobs (controllers run consolidated in this process) -------
     async def jobs_launch(request):
         body = await _json_body(request, 'jobs_launch')
-        from skypilot_tpu import admin_policy
-        if 'tasks' in body:
-            # Pipeline: a chain Dag of tasks run sequentially.
-            from skypilot_tpu import dag as dag_lib
-            payload = dag_lib.Dag(name=body.get('name'))
-            prev = None
-            for cfg in body['tasks']:
-                t = admin_policy.apply(
-                    task_lib.Task.from_yaml_config(cfg), 'jobs')
-                payload.add(t)
-                if prev is not None:
-                    payload.add_edge(prev, t)
-                prev = t
-        else:
-            payload = admin_policy.apply(
+
+        def build_payload():
+            from skypilot_tpu import admin_policy
+            if 'tasks' in body:
+                # Pipeline: a chain Dag of tasks run sequentially.
+                from skypilot_tpu import dag as dag_lib
+                dag = dag_lib.Dag(name=body.get('name'))
+                prev = None
+                for cfg in body['tasks']:
+                    t = admin_policy.apply(
+                        task_lib.Task.from_yaml_config(cfg), 'jobs')
+                    dag.add(t)
+                    if prev is not None:
+                        dag.add_edge(prev, t)
+                    prev = t
+                return dag
+            return admin_policy.apply(
                 task_lib.Task.from_yaml_config(body['task']), 'jobs')
+
+        payload = _with_identity(request, build_payload)()
         name = body.get('name')
 
         def work():
@@ -328,13 +375,15 @@ def make_app() -> web.Application:
             return {'job_id': jobs_lib.launch(payload, name)}
 
         request_id = request.app['executor'].submit(
-            'jobs_launch', body, work, long=False)
+            'jobs_launch', body, _with_identity(request, work), long=False)
         return web.json_response({'request_id': request_id})
 
     async def jobs_queue(request):
         from skypilot_tpu import jobs as jobs_lib
+        all_users = request.query.get('all_users', '0') == '1'
         records = await asyncio.get_event_loop().run_in_executor(
-            None, jobs_lib.queue)
+            None, _with_identity(
+                request, lambda: jobs_lib.queue(all_users=all_users)))
         out = []
         for r in records:
             r = dict(r)
@@ -348,7 +397,8 @@ def make_app() -> web.Application:
         from skypilot_tpu import jobs as jobs_lib
         job_id = int(body['job_id'])
         ok = await asyncio.get_event_loop().run_in_executor(
-            None, lambda: jobs_lib.cancel(job_id))
+            None, _with_identity(request,
+                                 lambda: jobs_lib.cancel(job_id)))
         return web.json_response({'cancelled': ok})
 
     async def jobs_logs(request):
@@ -358,7 +408,9 @@ def make_app() -> web.Application:
         from skypilot_tpu import exceptions as exc
         from skypilot_tpu.jobs import core as jobs_core
         rec = jobs_state.get(job_id)
-        if rec is None:
+        from skypilot_tpu import workspaces as workspaces_lib
+        if rec is None or not _with_identity(
+                request, lambda: workspaces_lib.visible(rec))():
             return web.json_response({'error': 'job logs unavailable'},
                                      status=404)
         try:
@@ -382,9 +434,13 @@ def make_app() -> web.Application:
     # ----- serve (controllers run consolidated in this process) --------------
     async def serve_up(request):
         body = await _json_body(request, 'serve_up')
-        from skypilot_tpu import admin_policy
-        task = admin_policy.apply(
-            task_lib.Task.from_yaml_config(body['task']), 'serve')
+
+        def build_task():
+            from skypilot_tpu import admin_policy
+            return admin_policy.apply(
+                task_lib.Task.from_yaml_config(body['task']), 'serve')
+
+        task = _with_identity(request, build_task)()
         name = body.get('name')
 
         def work():
@@ -392,7 +448,7 @@ def make_app() -> web.Application:
             return serve_lib.up(task, name)
 
         request_id = request.app['executor'].submit(
-            'serve_up', body, work, long=False)
+            'serve_up', body, _with_identity(request, work), long=False)
         return web.json_response({'request_id': request_id})
 
     async def serve_down(request):
@@ -446,8 +502,10 @@ def make_app() -> web.Application:
                                      status=404)
 
     async def cost_report(request):
+        all_users = request.query.get('all_users', '0') == '1'
         report = await asyncio.get_event_loop().run_in_executor(
-            None, core.cost_report)
+            None, _with_identity(
+                request, lambda: core.cost_report(all_users=all_users)))
         return web.json_response(report, dumps=lambda o: json.dumps(
             o, default=str))
 

@@ -19,3 +19,9 @@ API_VERSION = 2
 MIN_COMPATIBLE_API_VERSION = 1
 
 API_VERSION_HEADER = 'X-SkyTPU-API-Version'
+
+# Caller identity, forwarded by the SDK on every call (trusted from the
+# authenticated channel — the bearer token gates the API, like the
+# reference trusts its auth proxy's user header).
+USER_HEADER = 'X-SkyTPU-User'
+WORKSPACE_HEADER = 'X-SkyTPU-Workspace'

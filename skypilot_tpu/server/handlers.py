@@ -71,6 +71,14 @@ HANDLERS: Dict[str, Callable[[Dict[str, Any]], Any]] = {
 def run_request(request_id: str, name: str, body: Dict[str, Any]) -> None:
     """Worker-process entry point: execute and record to the requests DB.
     Exit code is irrelevant — the DB row is the result channel."""
+    # Re-create the caller's identity in this fresh process (the route
+    # injected it; env is private to this per-request worker).
+    user = body.pop('_user', None)
+    workspace = body.pop('_workspace', None)
+    if user:
+        os.environ['SKYTPU_USER'] = user
+    if workspace:
+        os.environ['SKYTPU_WORKSPACE'] = workspace
     requests_db.set_status(request_id, RequestStatus.RUNNING,
                            pid=os.getpid())
     try:

@@ -1,0 +1,96 @@
+"""User identity + RBAC (capability parity: sky/users/ — rbac.py roles,
+permission.py checks; identity columns on state rows as in
+sky/global_user_state.py user_hash).
+
+Identity is ambient: ``SKYTPU_USER`` env (or the OS login), overridable
+per-request on the server (the SDK forwards the caller's identity in the
+``X-SkyTPU-User`` header).  Roles come from the layered config:
+
+    users:
+      alice: admin
+      bob: user
+
+RBAC activates only when a ``users:`` section exists — with none, every
+caller is admin and nothing is restricted (single-user/library use).
+When active, non-admins may only mutate clusters they own; reads stay
+workspace-scoped but unrestricted by role.  Identity is trusted from the
+authenticated channel (the bearer token gates the API; the reference
+similarly trusts its auth proxy's user header, sky/server/server.py).
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import getpass
+import os
+import threading
+from typing import Any, Dict, Iterator, Optional
+
+from skypilot_tpu import exceptions
+
+ADMIN = 'admin'
+USER = 'user'
+
+_local = threading.local()
+
+
+@dataclasses.dataclass(frozen=True)
+class User:
+    name: str
+    role: str
+
+
+def _configured_roles() -> Optional[Dict[str, str]]:
+    from skypilot_tpu import sky_config
+    roles = sky_config.get_nested(('users',), None)
+    if roles is None:
+        return None
+    return {str(k): str(v) for k, v in roles.items()}
+
+
+def rbac_enabled() -> bool:
+    return _configured_roles() is not None
+
+
+def current_user() -> User:
+    """The acting user: per-request override > env > OS login."""
+    name = getattr(_local, 'override_name', None)
+    if name is None:
+        name = os.environ.get('SKYTPU_USER')
+    if name is None:
+        try:
+            name = getpass.getuser()
+        except Exception:  # pylint: disable=broad-except
+            name = 'unknown'
+    roles = _configured_roles()
+    if roles is None:
+        role = ADMIN                     # RBAC off: nobody is restricted
+    else:
+        role = roles.get(name, USER)
+    if role not in (ADMIN, USER):
+        raise exceptions.InvalidSkyConfigError(
+            f'users.{name}: role must be admin or user, got {role!r}')
+    return User(name=name, role=role)
+
+
+@contextlib.contextmanager
+def override(name: Optional[str]) -> Iterator[None]:
+    """Act as `name` within this thread (server per-request identity)."""
+    prev = getattr(_local, 'override_name', None)
+    _local.override_name = name
+    try:
+        yield
+    finally:
+        _local.override_name = prev
+
+
+def check_cluster_op(record: Dict[str, Any], operation: str) -> None:
+    """Non-admins may only mutate their own clusters."""
+    user = current_user()
+    if user.role == ADMIN:
+        return
+    owner = record.get('user_name')
+    if owner is not None and owner != user.name:
+        raise exceptions.PermissionDeniedError(
+            f'{operation} on cluster {record["name"]!r} denied: owned by '
+            f'{owner!r}, you are {user.name!r} (role {user.role})')

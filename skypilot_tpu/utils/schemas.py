@@ -164,6 +164,22 @@ CONFIG_SCHEMA: Dict[str, Any] = {
         },
         'logs': {'type': 'object'},
         'admin_policy': {'type': 'string'},
+        'users': {
+            'type': 'object',
+            'additionalProperties': {'enum': ['admin', 'user']},
+        },
+        'active_workspace': {'type': 'string'},
+        'workspaces': {
+            'type': 'object',
+            'additionalProperties': {
+                'type': 'object',
+                'additionalProperties': False,
+                'properties': {
+                    'allowed_clouds': {'type': 'array',
+                                       'items': {'type': 'string'}},
+                },
+            },
+        },
     },
 }
 

@@ -1,0 +1,90 @@
+"""Workspaces: named isolation domains over one API server (capability
+parity: sky/workspaces/ — core.py get/update, the active_workspace
+config key, per-workspace cloud restrictions).
+
+Config:
+
+    active_workspace: team-a        # default workspace for this client
+    workspaces:
+      team-a: {}
+      team-b:
+        allowed_clouds: [gcp]
+
+The active workspace is ambient (``SKYTPU_WORKSPACE`` env >
+``active_workspace`` config > ``default``), overridable per-request on
+the server (SDK forwards ``X-SkyTPU-Workspace``).  Every cluster and
+managed job is stamped with the workspace it was created in; clusters in
+other workspaces are invisible to user-facing ops — operating on one
+raises ClusterDoesNotExistError, exactly as if it were not there.  With
+no ``workspaces:`` section configured, everything lives in ``default``
+and isolation is a no-op.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from typing import Any, Dict, Iterator, List, Optional
+
+from skypilot_tpu import exceptions
+
+DEFAULT_WORKSPACE = 'default'
+
+_local = threading.local()
+
+
+def all_workspaces() -> Dict[str, Dict[str, Any]]:
+    from skypilot_tpu import sky_config
+    spaces = sky_config.get_nested(('workspaces',), None)
+    if not spaces:
+        return {DEFAULT_WORKSPACE: {}}
+    out = {DEFAULT_WORKSPACE: {}}
+    out.update({str(k): dict(v or {}) for k, v in spaces.items()})
+    return out
+
+
+def active_workspace() -> str:
+    name = getattr(_local, 'override_name', None)
+    if name is None:
+        name = os.environ.get('SKYTPU_WORKSPACE')
+    if name is None:
+        from skypilot_tpu import sky_config
+        name = sky_config.get_nested(('active_workspace',), None)
+    return str(name) if name else DEFAULT_WORKSPACE
+
+
+def validate_active() -> str:
+    """The active workspace, checked against the configured set."""
+    name = active_workspace()
+    spaces = all_workspaces()
+    if name not in spaces:
+        raise exceptions.InvalidSkyConfigError(
+            f'active workspace {name!r} is not defined; configured '
+            f'workspaces: {sorted(spaces)}')
+    return name
+
+
+@contextlib.contextmanager
+def override(name: Optional[str]) -> Iterator[None]:
+    """Act in workspace `name` within this thread."""
+    prev = getattr(_local, 'override_name', None)
+    _local.override_name = name
+    try:
+        yield
+    finally:
+        _local.override_name = prev
+
+
+def visible(record: Dict[str, Any]) -> bool:
+    """Is this cluster/job record visible from the active workspace?
+    Legacy rows (no workspace column value) live in `default`."""
+    ws = record.get('workspace') or DEFAULT_WORKSPACE
+    return ws == active_workspace()
+
+
+def allowed_clouds(name: Optional[str] = None) -> Optional[List[str]]:
+    """Per-workspace cloud restriction, or None for no restriction."""
+    spaces = all_workspaces()
+    cfg = spaces.get(name or active_workspace(), {})
+    clouds = cfg.get('allowed_clouds')
+    return [str(c).lower() for c in clouds] if clouds else None

@@ -1,0 +1,197 @@
+"""Users/RBAC + workspaces (parity: sky/users/ roles & permission
+checks; sky/workspaces/ isolation + per-workspace cloud restriction)."""
+import pytest
+import requests as requests_lib
+
+from skypilot_tpu import core
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import users
+from skypilot_tpu import workspaces
+from skypilot_tpu.server.constants import USER_HEADER, WORKSPACE_HEADER
+
+from tests.test_api_server import api_server, _mk_local_task  # noqa: F401
+
+
+def _launch_local(name, run='echo hi'):
+    from skypilot_tpu import execution
+    job_id, handle = execution.launch(_mk_local_task(run), name,
+                                      detach_run=True)
+    return handle
+
+
+def _write_cfg(tmp_home, text):
+    (tmp_home / '.skytpu.yaml').write_text(text)
+
+
+# ----- identity & roles ------------------------------------------------------
+def test_current_user_defaults_to_admin_without_rbac(tmp_home, monkeypatch):
+    monkeypatch.setenv('SKYTPU_USER', 'solo')
+    u = users.current_user()
+    assert u.name == 'solo' and u.role == users.ADMIN
+
+
+def test_roles_from_config(tmp_home, monkeypatch):
+    _write_cfg(tmp_home, 'users:\n  alice: admin\n  bob: user\n')
+    monkeypatch.setenv('SKYTPU_USER', 'bob')
+    assert users.current_user().role == users.USER
+    with users.override('alice'):
+        assert users.current_user() == users.User('alice', users.ADMIN)
+    # Unlisted users get the unprivileged role once RBAC is on.
+    with users.override('mallory'):
+        assert users.current_user().role == users.USER
+
+
+# ----- cluster stamping & status filtering -----------------------------------
+def test_cluster_records_user_and_workspace(tmp_home, enable_all_clouds,
+                                            monkeypatch):
+    monkeypatch.setenv('SKYTPU_USER', 'alice')
+    monkeypatch.setenv('SKYTPU_WORKSPACE', 'default')
+    _launch_local('uwc')
+    rec = global_user_state.get_cluster('uwc')
+    assert rec['user_name'] == 'alice'
+    assert rec['workspace'] == 'default'
+
+
+def test_status_filters_by_user_by_default(tmp_home, enable_all_clouds,
+                                           monkeypatch):
+    monkeypatch.setenv('SKYTPU_USER', 'alice')
+    _launch_local('mine')
+    with users.override('bob'):
+        assert [r['name'] for r in core.status()] == []
+        assert [r['name'] for r in core.status(all_users=True)] == ['mine']
+    assert [r['name'] for r in core.status()] == ['mine']
+
+
+# ----- RBAC on mutating ops --------------------------------------------------
+def test_non_admin_cannot_touch_others_clusters(tmp_home,
+                                                enable_all_clouds,
+                                                monkeypatch):
+    _write_cfg(tmp_home, 'users:\n  alice: admin\n  bob: user\n')
+    monkeypatch.setenv('SKYTPU_USER', 'alice')
+    _launch_local('adm')
+    with users.override('bob'):
+        with pytest.raises(exceptions.PermissionDeniedError):
+            core.down('adm')
+        with pytest.raises(exceptions.PermissionDeniedError):
+            core.autostop('adm', 5)
+    # the owner (an admin) still can
+    core.down('adm')
+    assert global_user_state.get_cluster('adm') is None
+
+
+def test_admin_can_down_others(tmp_home, enable_all_clouds, monkeypatch):
+    _write_cfg(tmp_home, 'users:\n  alice: admin\n  bob: user\n')
+    monkeypatch.setenv('SKYTPU_USER', 'bob')
+    _launch_local('bobs')
+    with users.override('alice'):
+        core.down('bobs')
+    assert global_user_state.get_cluster('bobs') is None
+
+
+# ----- workspace isolation ---------------------------------------------------
+def test_workspace_isolation(tmp_home, enable_all_clouds, monkeypatch):
+    _write_cfg(tmp_home, 'workspaces:\n  team-a: {}\n  team-b: {}\n')
+    monkeypatch.setenv('SKYTPU_WORKSPACE', 'team-a')
+    _launch_local('wsa')
+    assert [r['name'] for r in core.status()] == ['wsa']
+    with workspaces.override('team-b'):
+        assert core.status() == []
+        # Invisible == nonexistent, even for mutation.
+        with pytest.raises(exceptions.ClusterDoesNotExistError):
+            core.down('wsa')
+        # Reusing the name from another workspace is blocked, not
+        # hijacked.
+        with pytest.raises(exceptions.PermissionDeniedError):
+            _launch_local('wsa')
+    core.down('wsa')
+
+
+def test_undefined_workspace_rejected(tmp_home, enable_all_clouds,
+                                      monkeypatch):
+    _write_cfg(tmp_home, 'workspaces:\n  team-a: {}\n')
+    monkeypatch.setenv('SKYTPU_WORKSPACE', 'nope')
+    with pytest.raises(exceptions.InvalidSkyConfigError):
+        _launch_local('bad')
+
+
+def test_workspace_allowed_clouds(tmp_home, monkeypatch):
+    _write_cfg(tmp_home,
+               'workspaces:\n  locked:\n    allowed_clouds: [gcp]\n')
+    monkeypatch.setenv('SKYTPU_ENABLED_CLOUDS', 'gcp,local')
+    from skypilot_tpu import clouds as clouds_lib
+    names = {c.NAME for c in clouds_lib.enabled_clouds()}
+    assert names == {'gcp', 'local'}
+    with workspaces.override('locked'):
+        names = {c.NAME for c in clouds_lib.enabled_clouds()}
+        assert names == {'gcp'}
+
+
+# ----- REST propagation ------------------------------------------------------
+def test_identity_headers_over_rest(api_server, tmp_home):
+    body = {'task': _mk_local_task().to_yaml_config(),
+            'cluster_name': 'resty'}
+    resp = requests_lib.post(f'{api_server}/launch', json=body,
+                             headers={USER_HEADER: 'carol'})
+    assert resp.status_code == 200
+    rid = resp.json()['request_id']
+    from skypilot_tpu.client import sdk
+    sdk.get(rid)
+    rec = global_user_state.get_cluster('resty')
+    assert rec['user_name'] == 'carol'
+    # carol sees it; dave does not (default per-user filter)
+    as_carol = requests_lib.get(f'{api_server}/status',
+                                headers={USER_HEADER: 'carol'}).json()
+    as_dave = requests_lib.get(f'{api_server}/status',
+                               headers={USER_HEADER: 'dave'}).json()
+    assert [r['name'] for r in as_carol] == ['resty']
+    assert as_dave == []
+    all_u = requests_lib.get(f'{api_server}/status',
+                             params={'all_users': '1'},
+                             headers={USER_HEADER: 'dave'}).json()
+    assert [r['name'] for r in all_u] == ['resty']
+
+
+def test_workspace_header_over_rest(api_server, tmp_home):
+    (tmp_home / '.skytpu.yaml').write_text(
+        'workspaces:\n  team-a: {}\n  team-b: {}\n')
+    body = {'task': _mk_local_task().to_yaml_config(),
+            'cluster_name': 'wsrest'}
+    resp = requests_lib.post(f'{api_server}/launch', json=body,
+                             headers={WORKSPACE_HEADER: 'team-a'})
+    assert resp.status_code == 200
+    from skypilot_tpu.client import sdk
+    sdk.get(resp.json()['request_id'])
+    in_a = requests_lib.get(f'{api_server}/status',
+                            params={'all_users': '1'},
+                            headers={WORKSPACE_HEADER: 'team-a'}).json()
+    in_b = requests_lib.get(f'{api_server}/status',
+                            params={'all_users': '1'},
+                            headers={WORKSPACE_HEADER: 'team-b'}).json()
+    assert [r['name'] for r in in_a] == ['wsrest']
+    assert in_b == []
+
+
+# ----- managed jobs tagging --------------------------------------------------
+def test_jobs_tagged_and_filtered(tmp_home, enable_all_clouds,
+                                  monkeypatch):
+    monkeypatch.setenv('SKYTPU_JOBS_POLL_INTERVAL', '0.25')
+    monkeypatch.setenv('SKYTPU_USER', 'alice')
+    from skypilot_tpu import jobs
+    from skypilot_tpu.jobs import controller as controller_lib
+    job_id = jobs.launch(_mk_local_task('echo j'))
+    controller_lib.wait_job(job_id, timeout_s=60)
+    rec = jobs.queue()[0]
+    assert rec['user_name'] == 'alice'
+    assert rec['workspace'] == 'default'
+    with users.override('bob'):
+        assert jobs.queue() == []
+        assert len(jobs.queue(all_users=True)) == 1
+        # bob (RBAC off → admin) may cancel; turn RBAC on and he may not.
+    _write_cfg(tmp_home, 'users:\n  alice: admin\n  bob: user\n')
+    job2 = jobs.launch(_mk_local_task('sleep 30', ))
+    with users.override('bob'):
+        with pytest.raises(exceptions.PermissionDeniedError):
+            jobs.cancel(job2)
+    assert jobs.cancel(job2)
+    controller_lib.wait_job(job2, timeout_s=60)
