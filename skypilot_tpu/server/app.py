@@ -96,6 +96,20 @@ async def _auth_middleware(request, handler):
 
 
 @web.middleware
+async def _drain_middleware(request, handler):
+    """Graceful restart step 1: a draining server refuses new mutations
+    with 503 (clients retry against the replacement instance) while
+    reads, request polls, and cancels keep working so in-flight work can
+    finish and be observed."""
+    if request.app.get('draining') and request.method == 'POST' and \
+            not request.path.endswith('/cancel') and \
+            request.path != '/api/drain':
+        return web.json_response(
+            {'error': 'server is draining; retry shortly'}, status=503)
+    return await handler(request)
+
+
+@web.middleware
 async def _version_middleware(request, handler):
     """Reject clients older than this server still understands with 426
     Upgrade Required (parity: the reference's client/server API-version
@@ -126,35 +140,57 @@ async def _version_middleware(request, handler):
 def make_app() -> web.Application:
     app = web.Application(middlewares=[_auth_middleware,
                                        _version_middleware,
+                                       _drain_middleware,
                                        _error_middleware])
     executor = RequestExecutor()
     app['executor'] = executor
 
+    app['draining'] = False
+
     async def on_cleanup(app):
+        if 'daemons' in app:
+            app['daemons'].stop()
         executor.shutdown()
 
     app.on_cleanup.append(on_cleanup)
 
     async def on_startup(app):
-        # Re-adopt managed jobs and services orphaned by a server
-        # restart: their controller threads live in this process
-        # (consolidation mode).
+        # Re-adopt everything a restart orphaned: queued/pending request
+        # rows (the requests DB is the durable queue transport), then
+        # managed-job and serve controllers (their threads live in this
+        # process — consolidation mode).
         from skypilot_tpu.jobs import controller as jobs_controller
         from skypilot_tpu.serve import controller as serve_controller
-        await asyncio.get_event_loop().run_in_executor(
+        loop = asyncio.get_event_loop()
+        await loop.run_in_executor(None, executor.recover)
+        await loop.run_in_executor(
             None, jobs_controller.maybe_start_controllers)
-        await asyncio.get_event_loop().run_in_executor(
+        await loop.run_in_executor(
             None, serve_controller.maybe_start_controllers)
+        # Background daemons: requests GC, cloud-truth status refresh,
+        # controller liveness.  SKYTPU_DAEMONS=0 disables (tests).
+        if os.environ.get('SKYTPU_DAEMONS', '1') != '0':
+            from skypilot_tpu.server import daemons as daemons_lib
+            app['daemons'] = daemons_lib.DaemonSet(
+                daemons_lib.default_daemons())
+            app['daemons'].start()
 
     app.on_startup.append(on_startup)
 
     # ----- health / meta -----------------------------------------------------
     async def health(request):
         return web.json_response({
-            'status': 'healthy',
+            'status': 'draining' if app['draining'] else 'healthy',
             'api_version': API_VERSION,
             'min_compatible_api_version': MIN_COMPATIBLE_API_VERSION,
         })
+
+    async def drain(request):
+        """Begin graceful shutdown: refuse new mutations, keep serving
+        reads; in-flight worker processes run to completion (the
+        process-level wait happens in on_shutdown / executor.drain)."""
+        app['draining'] = True
+        return web.json_response({'draining': True})
 
     async def metrics_route(request):
         from skypilot_tpu.server import metrics as metrics_lib
@@ -553,6 +589,7 @@ def make_app() -> web.Application:
     app.router.add_get('/cost_report', cost_report)
     app.router.add_get('/accelerators', accelerators)
     app.router.add_get('/check', check)
+    app.router.add_post('/api/drain', drain)
     return app
 
 
@@ -562,7 +599,20 @@ def main() -> None:
     parser.add_argument('--port', type=int, default=8700)
     parser.add_argument('--host', default='127.0.0.1')
     args = parser.parse_args()
-    web.run_app(make_app(), host=args.host, port=args.port,
+    app = make_app()
+
+    async def on_shutdown(app):
+        # SIGTERM/SIGINT → aiohttp shutdown: flip to draining and wait
+        # for in-flight worker processes before cleanup tears them down.
+        app['draining'] = True
+        timeout = float(os.environ.get('SKYTPU_DRAIN_TIMEOUT', '300'))
+        drained = await asyncio.get_event_loop().run_in_executor(
+            None, lambda: app['executor'].drain(timeout))
+        if not drained:
+            logger.warning('drain timed out; terminating workers')
+
+    app.on_shutdown.append(on_shutdown)
+    web.run_app(app, host=args.host, port=args.port,
                 print=lambda *a: logger.info(
                     f'API server on {args.host}:{args.port}'))
 

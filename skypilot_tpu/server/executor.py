@@ -41,13 +41,80 @@ _SHORT_WORKERS = 16
 _MP_CTX = multiprocessing.get_context('spawn')
 
 
+class _AdoptedWorker:
+    """Process-like wrapper over a bare pid: a worker spawned by a
+    previous server incarnation that is still running.  Lets cancel/
+    drain/shutdown manage re-adopted workers exactly like fresh ones."""
+
+    def __init__(self, pid: int) -> None:
+        self.pid = pid
+        self.exitcode: Optional[int] = None
+
+    def is_alive(self) -> bool:
+        import os
+        try:
+            os.kill(self.pid, 0)
+            return True
+        except (ProcessLookupError, PermissionError):
+            return False
+
+    def terminate(self) -> None:
+        import os
+        import signal
+        try:
+            os.kill(self.pid, signal.SIGTERM)
+        except ProcessLookupError:
+            pass
+
+    def kill(self) -> None:
+        import os
+        import signal
+        try:
+            os.kill(self.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        deadline = None if timeout is None else time.time() + timeout
+        while self.is_alive():
+            if deadline is not None and time.time() > deadline:
+                return
+            time.sleep(0.2)
+        self.exitcode = 0   # unknowable for a non-child; treat as clean
+
+
+def _pid_started_before(pid: int, created_at: float) -> bool:
+    """True if `pid` started BEFORE the request existed — i.e. the pid
+    was recycled to an unrelated process (e.g. after a host reboot) and
+    cannot be our worker.  Linux /proc; unknown -> False (assume ours)."""
+    try:
+        with open(f'/proc/{pid}/stat', 'r') as f:
+            fields = f.read().rsplit(')', 1)[1].split()
+        start_ticks = int(fields[19])                  # starttime
+        with open('/proc/uptime', 'r') as f:
+            uptime = float(f.read().split()[0])
+        hz = 100.0
+        import os
+        try:
+            hz = float(os.sysconf('SC_CLK_TCK'))
+        except (ValueError, OSError):
+            pass
+        started_at = time.time() - uptime + start_ticks / hz
+        return started_at < created_at - 5.0           # 5s clock slack
+    except (OSError, IndexError, ValueError):
+        return False
+
+
 class RequestExecutor:
     def __init__(self) -> None:
         self._long = concurrent.futures.ThreadPoolExecutor(
             _LONG_WORKERS, thread_name_prefix='skytpu-long')
         self._short = concurrent.futures.ThreadPoolExecutor(
             _SHORT_WORKERS, thread_name_prefix='skytpu-short')
-        self._procs: Dict[str, multiprocessing.Process] = {}
+        self._procs: Dict[str, Any] = {}
+        # Dispatched-but-unfinished LONG request ids (incl. those still
+        # queued for a pool slot) — what drain() must wait out.
+        self._active: set = set()
         self._lock = threading.Lock()
 
     # ----- LONG: per-request worker process ----------------------------------
@@ -56,10 +123,23 @@ class RequestExecutor:
         from skypilot_tpu.server import handlers
         assert name in handlers.HANDLERS, name
         request_id = requests_db.create(name, body, 'long')
+        self._dispatch(request_id, name, body)
+        return request_id
+
+    def _dispatch(self, request_id: str, name: str,
+                  body: Dict[str, Any]) -> None:
+        """Supervise one already-persisted request in a worker process
+        (shared by fresh submissions and startup re-adoption of queued
+        rows — the requests DB is the durable queue)."""
+        from skypilot_tpu.server import handlers
+        with self._lock:
+            self._active.add(request_id)
 
         def supervise():
             rec = requests_db.get(request_id)
             if rec is not None and rec['status'] is RequestStatus.CANCELLED:
+                with self._lock:
+                    self._active.discard(request_id)
                 return   # cancelled while queued
             proc = _MP_CTX.Process(
                 target=handlers.run_request,
@@ -88,6 +168,7 @@ class RequestExecutor:
             finally:
                 with self._lock:
                     self._procs.pop(request_id, None)
+                    self._active.discard(request_id)
                 metrics.add_gauge('skytpu_requests_in_flight', -1,
                                   kind='long')
                 final = requests_db.get(request_id)
@@ -98,7 +179,99 @@ class RequestExecutor:
                                 time.perf_counter() - t0, name=name)
 
         self._long.submit(supervise)
-        return request_id
+
+    def recover(self) -> None:
+        """Re-adopt the persisted request queue after a server restart
+        (parity: queue-transport semantics, sky/server/requests/queues —
+        here the requests DB is the sqlite-backed transport):
+
+        - RUNNING rows whose worker pid is gone died with the old server
+          -> FAILED (the workload may have half-happened; the cluster
+          record stays reattachable, so a retry is safe);
+        - PENDING rows for process handlers were queued but never
+          started -> dispatch them now;
+        - PENDING rows for thread work (closures died with the process)
+          -> FAILED; their subsystems (jobs/serve controllers) have
+          their own re-adoption paths.
+        """
+        import os
+        from skypilot_tpu.server import handlers
+        for rec in requests_db.nonterminal_requests():
+            rid = rec['request_id']
+            if rec['status'] is RequestStatus.RUNNING:
+                pid = rec['pid']
+                alive = False
+                if pid:
+                    try:
+                        os.kill(pid, 0)
+                        alive = True
+                    except (ProcessLookupError, PermissionError):
+                        alive = False
+                # Guard against pid recycling (e.g. host reboot): a
+                # process older than the request cannot be its worker.
+                if alive and _pid_started_before(pid, rec['created_at']):
+                    alive = False
+                if not alive:
+                    requests_db.set_status(
+                        rid, RequestStatus.FAILED,
+                        error='server restarted while request was '
+                              'running; worker is gone')
+                else:
+                    # The old server's worker survived the restart:
+                    # adopt it so cancel/drain/shutdown can manage it,
+                    # and mark the row terminal if it dies without
+                    # recording a result.
+                    logger.info(f'adopting live worker pid={pid} for '
+                                f'request {rid}')
+                    self._adopt(rid, pid)
+                continue
+            # PENDING
+            if rec['name'] in handlers.HANDLERS:
+                logger.info(f're-adopting queued request {rid} '
+                            f'({rec["name"]})')
+                self._dispatch(rid, rec['name'], rec['body'])
+            else:
+                requests_db.set_status(
+                    rid, RequestStatus.FAILED,
+                    error='server restarted before this request started; '
+                          'resubmit it')
+
+    def _adopt(self, request_id: str, pid: int) -> None:
+        """Supervise a worker inherited from a previous server run."""
+        worker = _AdoptedWorker(pid)
+        with self._lock:
+            self._procs[request_id] = worker
+            self._active.add(request_id)
+
+        def supervise():
+            try:
+                worker.join()
+                # Worker wrote its own terminal status on success; if it
+                # died without one, the guarded UPDATE below lands.
+                requests_db.set_status(
+                    request_id, RequestStatus.FAILED,
+                    error='adopted worker exited without recording a '
+                          'result')
+            finally:
+                with self._lock:
+                    self._procs.pop(request_id, None)
+                    self._active.discard(request_id)
+
+        self._long.submit(supervise)
+
+    def drain(self, timeout_s: float = 300.0) -> bool:
+        """Graceful shutdown step 2 (after the app stops accepting
+        mutations): wait out every dispatched LONG request — running
+        worker processes AND requests still queued for a pool slot.
+        Returns True if everything drained within the timeout."""
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            with self._lock:
+                busy = bool(self._active)
+            if not busy:
+                return True
+            time.sleep(0.25)
+        return False
 
     def cancel(self, request_id: str) -> bool:
         """Cancel a queued or in-flight LONG request.  Returns True if

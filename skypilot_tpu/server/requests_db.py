@@ -124,3 +124,28 @@ def list_requests(limit: int = 100) -> List[Dict[str, Any]]:
         'SELECT request_id FROM requests ORDER BY created_at DESC LIMIT ?',
         (limit,))
     return [get(r['request_id']) for r in rows]
+
+
+def nonterminal_requests() -> List[Dict[str, Any]]:
+    """PENDING/RUNNING rows — the persisted queue the server re-adopts
+    after a restart (the requests DB IS the sqlite queue transport)."""
+    rows = db_utils.query(
+        _ensure(), 'SELECT request_id FROM requests WHERE status IN (?,?) '
+        'ORDER BY created_at',
+        (RequestStatus.PENDING.value, RequestStatus.RUNNING.value))
+    return [get(r['request_id']) for r in rows]
+
+
+def prune(max_age_s: float) -> int:
+    """Delete terminal requests older than max_age_s (requests-GC daemon;
+    parity: the reference cleans finished requests periodically,
+    sky/server/requests/requests.py clean_finished_requests)."""
+    cutoff = time.time() - max_age_s
+    path = _ensure()
+    with db_utils.transaction(path) as conn:
+        cur = conn.execute(
+            'DELETE FROM requests WHERE status IN (?,?,?) AND '
+            'finished_at IS NOT NULL AND finished_at < ?',
+            (RequestStatus.SUCCEEDED.value, RequestStatus.FAILED.value,
+             RequestStatus.CANCELLED.value, cutoff))
+        return cur.rowcount

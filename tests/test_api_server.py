@@ -15,6 +15,9 @@ def api_server(tmp_home, enable_all_clouds, monkeypatch):
     """Real aiohttp server on a random port, in a background thread."""
     import asyncio
     from skypilot_tpu.server.app import make_app
+    # Background daemons off: their jittered ticks (status refresh,
+    # controller re-adoption) would race deliberately-staged test state.
+    monkeypatch.setenv('SKYTPU_DAEMONS', '0')
 
     loop = asyncio.new_event_loop()
     server_holder = {}

@@ -1,0 +1,145 @@
+"""Background daemons, durable request queue recovery, graceful drain
+(parity: sky/server/requests/requests.py clean_finished_requests,
+queue transports, multi-worker graceful restart)."""
+import time
+
+import pytest
+import requests as requests_lib
+
+from skypilot_tpu.server import daemons as daemons_lib
+from skypilot_tpu.server import requests_db
+from skypilot_tpu.server.executor import RequestExecutor
+from skypilot_tpu.server.requests_db import RequestStatus
+
+from tests.test_api_server import api_server, _mk_local_task  # noqa: F401
+
+
+# ----- requests GC -----------------------------------------------------------
+def test_prune_removes_only_old_terminal(tmp_home):
+    old = requests_db.create('launch', {}, 'long')
+    requests_db.set_status(old, RequestStatus.SUCCEEDED, result={})
+    live = requests_db.create('launch', {}, 'long')       # PENDING
+    fresh = requests_db.create('launch', {}, 'long')
+    requests_db.set_status(fresh, RequestStatus.FAILED, error='x')
+    # Age the old one: pretend it finished an hour ago.
+    from skypilot_tpu.utils import db_utils
+    db_utils.execute(requests_db._ensure(),
+                     'UPDATE requests SET finished_at=? WHERE request_id=?',
+                     (time.time() - 3600, old))
+    assert requests_db.prune(max_age_s=600) == 1
+    assert requests_db.get(old) is None
+    assert requests_db.get(live) is not None
+    assert requests_db.get(fresh) is not None
+
+
+def test_requests_gc_daemon_fn(tmp_home, monkeypatch):
+    monkeypatch.setenv('SKYTPU_REQUESTS_RETENTION_HOURS', '0')
+    rid = requests_db.create('launch', {}, 'long')
+    requests_db.set_status(rid, RequestStatus.SUCCEEDED, result={})
+    daemons_lib._requests_gc()
+    assert requests_db.get(rid) is None
+
+
+# ----- durable queue recovery ------------------------------------------------
+def test_recover_fails_orphaned_running(tmp_home):
+    rid = requests_db.create('launch', {}, 'long')
+    # Simulate a worker that died with the old server: RUNNING + dead pid.
+    requests_db.set_status(rid, RequestStatus.RUNNING, pid=99999999)
+    ex = RequestExecutor()
+    try:
+        ex.recover()
+    finally:
+        ex.shutdown()
+    rec = requests_db.get(rid)
+    assert rec['status'] is RequestStatus.FAILED
+    assert 'restarted' in rec['error']
+
+
+def test_recover_dispatches_queued_process_requests(tmp_home,
+                                                    enable_all_clouds):
+    body = {'task': _mk_local_task().to_yaml_config(),
+            'cluster_name': 'requeued'}
+    rid = requests_db.create('launch', body, 'long')     # queued, never ran
+    ex = RequestExecutor()
+    try:
+        ex.recover()
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            rec = requests_db.get(rid)
+            if rec['status'].is_terminal():
+                break
+            time.sleep(0.3)
+        assert rec['status'] is RequestStatus.SUCCEEDED, rec['error']
+        assert rec['result']['cluster_name'] == 'requeued'
+    finally:
+        ex.shutdown()
+
+
+def test_recover_fails_unrecoverable_thread_requests(tmp_home):
+    rid = requests_db.create('jobs_launch', {}, 'short')  # closure is gone
+    ex = RequestExecutor()
+    try:
+        ex.recover()
+    finally:
+        ex.shutdown()
+    rec = requests_db.get(rid)
+    assert rec['status'] is RequestStatus.FAILED
+    assert 'resubmit' in rec['error']
+
+
+def test_recover_adopts_live_worker_and_cancel_kills_it(tmp_home):
+    import subprocess
+    proc = subprocess.Popen(['sleep', '300'])
+    rid = requests_db.create('launch', {}, 'long')
+    requests_db.set_status(rid, RequestStatus.RUNNING, pid=proc.pid)
+    ex = RequestExecutor()
+    try:
+        ex.recover()
+        rec = requests_db.get(rid)
+        assert rec['status'] is RequestStatus.RUNNING   # adopted, not failed
+        assert ex.cancel(rid)
+        proc.wait(timeout=10)                           # SIGTERMed
+        assert requests_db.get(rid)['status'] is RequestStatus.CANCELLED
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        ex.shutdown()
+
+
+# ----- graceful drain --------------------------------------------------------
+def test_drain_refuses_new_mutations_allows_reads(api_server):
+    resp = requests_lib.post(f'{api_server}/api/drain', json={})
+    assert resp.status_code == 200
+    health = requests_lib.get(f'{api_server}/api/health').json()
+    assert health['status'] == 'draining'
+    # Mutations are 503 ...
+    body = {'task': _mk_local_task().to_yaml_config()}
+    resp = requests_lib.post(f'{api_server}/launch', json=body)
+    assert resp.status_code == 503
+    # ... reads still work.
+    assert requests_lib.get(f'{api_server}/status').status_code == 200
+
+
+def test_executor_drain_waits_for_workers(tmp_home):
+    ex = RequestExecutor()
+    try:
+        assert ex.drain(timeout_s=1.0)   # nothing in flight
+    finally:
+        ex.shutdown()
+
+
+# ----- controller liveness ---------------------------------------------------
+def test_controller_liveness_readopts_jobs(tmp_home, enable_all_clouds,
+                                           monkeypatch):
+    monkeypatch.setenv('SKYTPU_JOBS_POLL_INTERVAL', '0.25')
+    from skypilot_tpu.jobs import controller as controller_lib
+    from skypilot_tpu.jobs import state as jobs_state
+    # A submitted job whose controller never started (e.g. the thread
+    # died): PENDING with no live controller.
+    jid = jobs_state.submit('orphan', _mk_local_task('echo o')
+                            .to_yaml_config())
+    assert not controller_lib.controller_alive(jid)
+    daemons_lib._controller_liveness()
+    final = controller_lib.wait_job(jid, timeout_s=60)
+    from skypilot_tpu.jobs.state import ManagedJobStatus
+    assert final is ManagedJobStatus.SUCCEEDED
