@@ -6,13 +6,15 @@ from typing import Dict, List, Optional
 from skypilot_tpu import exceptions
 from skypilot_tpu.clouds.cloud import Cloud, CloudCapability
 from skypilot_tpu.clouds.gcp import GCP
+from skypilot_tpu.clouds.kubernetes import Kubernetes
 from skypilot_tpu.clouds.local import Local
 
-__all__ = ['Cloud', 'CloudCapability', 'GCP', 'Local', 'get_cloud',
-           'enabled_clouds', 'CLOUD_REGISTRY']
+__all__ = ['Cloud', 'CloudCapability', 'GCP', 'Kubernetes', 'Local',
+           'get_cloud', 'enabled_clouds', 'CLOUD_REGISTRY']
 
 CLOUD_REGISTRY: Dict[str, Cloud] = {
     GCP.NAME: GCP(),
+    Kubernetes.NAME: Kubernetes(),
     Local.NAME: Local(),
 }
 

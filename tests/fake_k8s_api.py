@@ -1,0 +1,176 @@
+"""In-process fake of the Kubernetes core v1 pods API (sibling of
+fake_tpu_api.py / fake_gce_api.py).  Scriptable behavior:
+
+  fake.set_behavior('ok' | 'unschedulable' | 'quota')
+  fake.evict(namespace, pod_name)      # spot-node reclaim analog
+
+Pods materialize Running with a podIP immediately under 'ok';
+'unschedulable' leaves them Pending with an Unschedulable condition
+(GKE stockout analog); 'quota' rejects creation with a 403.
+"""
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict
+
+
+class _State:
+    def __init__(self):
+        self.pods: Dict[str, dict] = {}     # key: ns/name
+        self.behavior = 'ok'
+        self.next_ip = 1
+        self.lock = threading.Lock()
+
+
+class FakeK8sApi:
+    def __init__(self):
+        self.state = _State()
+        self.server = ThreadingHTTPServer(('127.0.0.1', 0),
+                                          self._make_handler())
+        self.thread = threading.Thread(target=self.server.serve_forever,
+                                       daemon=True)
+        self.thread.start()
+
+    @property
+    def endpoint(self) -> str:
+        return f'http://127.0.0.1:{self.server.server_port}'
+
+    def close(self):
+        self.server.shutdown()
+
+    # ----- scripting ---------------------------------------------------------
+    def set_behavior(self, behavior: str):
+        assert behavior in ('ok', 'unschedulable', 'quota')
+        self.state.behavior = behavior
+
+    def pod(self, namespace: str, name: str) -> dict:
+        return self.state.pods[f'{namespace}/{name}']
+
+    def evict(self, namespace: str, name: str):
+        """Spot reclaim: the pod fails with reason Evicted."""
+        with self.state.lock:
+            pod = self.state.pods[f'{namespace}/{name}']
+            pod['status'] = {'phase': 'Failed', 'reason': 'Evicted'}
+
+    def schedule_pending(self):
+        """Flip Pending (unschedulable) pods to Running — capacity
+        appeared."""
+        with self.state.lock:
+            for pod in self.state.pods.values():
+                if pod['status'].get('phase') == 'Pending':
+                    pod['status'] = {
+                        'phase': 'Running',
+                        'podIP': f'10.1.0.{self.state.next_ip}',
+                    }
+                    self.state.next_ip += 1
+
+    # ----- handler -----------------------------------------------------------
+    def _make_handler(self):
+        state = self.state
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code: int, body: dict):
+                blob = json.dumps(body).encode()
+                self.send_response(code)
+                self.send_header('Content-Type', 'application/json')
+                self.send_header('Content-Length', str(len(blob)))
+                self.end_headers()
+                self.wfile.write(blob)
+
+            def _status(self, code: int, message: str):
+                self._send(code, {'kind': 'Status', 'code': code,
+                                  'message': message})
+
+            def _body(self) -> dict:
+                length = int(self.headers.get('Content-Length', 0) or 0)
+                return (json.loads(self.rfile.read(length))
+                        if length else {})
+
+            def do_POST(self):
+                m = re.match(r'^/api/v1/namespaces/([^/]+)/pods$',
+                             self.path.split('?')[0])
+                if not m:
+                    return self._status(404, f'unknown POST {self.path}')
+                ns = m.group(1)
+                if state.behavior == 'quota':
+                    return self._status(
+                        403, 'pods "x" is forbidden: exceeded quota')
+                pod = self._body()
+                name = pod['metadata']['name']
+                key = f'{ns}/{name}'
+                with state.lock:
+                    if key in state.pods:
+                        return self._status(
+                            409, f'pods "{name}" already exists')
+                    if state.behavior == 'unschedulable':
+                        pod['status'] = {
+                            'phase': 'Pending',
+                            'conditions': [{
+                                'type': 'PodScheduled',
+                                'status': 'False',
+                                'reason': 'Unschedulable',
+                                'message': '0/3 nodes are available: 3 '
+                                           'Insufficient google.com/tpu.',
+                            }],
+                        }
+                    else:
+                        pod['status'] = {
+                            'phase': 'Running',
+                            'podIP': f'10.1.0.{state.next_ip}',
+                        }
+                        state.next_ip += 1
+                    state.pods[key] = pod
+                return self._send(201, pod)
+
+            def do_GET(self):
+                path, _, query = self.path.partition('?')
+                m = re.match(r'^/api/v1/namespaces/([^/]+)/pods/([^/]+)$',
+                             path)
+                if m:
+                    pod = state.pods.get(f'{m.group(1)}/{m.group(2)}')
+                    if pod is None:
+                        return self._status(404, 'pod not found')
+                    return self._send(200, pod)
+                m = re.match(r'^/api/v1/namespaces/([^/]+)/pods$', path)
+                if m:
+                    ns = m.group(1)
+                    selector = None
+                    for part in query.split('&'):
+                        if part.startswith('labelSelector='):
+                            from urllib.parse import unquote
+                            selector = unquote(part.split('=', 1)[1])
+                    items = []
+                    with state.lock:
+                        for key, pod in state.pods.items():
+                            if not key.startswith(f'{ns}/'):
+                                continue
+                            if selector:
+                                k, _, v = selector.partition('=')
+                                labels = pod['metadata'].get('labels', {})
+                                if labels.get(k) != v:
+                                    continue
+                            items.append(pod)
+                    return self._send(200, {'kind': 'PodList',
+                                            'items': items})
+                return self._status(404, f'unknown GET {path}')
+
+            def do_DELETE(self):
+                m = re.match(r'^/api/v1/namespaces/([^/]+)/pods/([^/]+)$',
+                             self.path.split('?')[0])
+                if not m:
+                    return self._status(404,
+                                        f'unknown DELETE {self.path}')
+                key = f'{m.group(1)}/{m.group(2)}'
+                with state.lock:
+                    pod = state.pods.pop(key, None)
+                if pod is None:
+                    return self._status(404, 'pod not found')
+                return self._send(200, pod)
+
+        return Handler

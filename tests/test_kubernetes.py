@@ -1,0 +1,141 @@
+"""Kubernetes substrate: cloud feasibility/capabilities + pod
+provisioner against the fake k8s API (parity:
+sky/clouds/kubernetes.py, sky/provision/kubernetes/instance.py)."""
+import pytest
+
+from skypilot_tpu import clouds as clouds_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import provision
+from skypilot_tpu.clouds import CloudCapability
+from skypilot_tpu.provision import InstanceStatus, ProvisionConfig
+from skypilot_tpu.resources import Resources
+
+
+@pytest.fixture
+def fake_k8s(monkeypatch):
+    from tests.fake_k8s_api import FakeK8sApi
+    fake = FakeK8sApi()
+    monkeypatch.setenv('SKYTPU_K8S_API_ENDPOINT', fake.endpoint)
+    monkeypatch.setenv('SKYTPU_K8S_UNSCHEDULABLE_GRACE_S', '0.5')
+    yield fake
+    fake.close()
+
+
+def _config(cluster='k1', num_nodes=1, **res):
+    res.setdefault('infra', 'kubernetes/main')
+    return ProvisionConfig(cluster_name=cluster, num_nodes=num_nodes,
+                           resources_config=res, region='main')
+
+
+# ----- cloud layer -----------------------------------------------------------
+def test_cloud_gates_and_feasibility():
+    cloud = clouds_lib.get_cloud('kubernetes')
+    res = Resources.from_yaml_config({'infra': 'kubernetes/main'})
+    assert not cloud.supports(CloudCapability.STOP, res)
+    assert not cloud.supports(CloudCapability.AUTOSTOP, res)
+    assert cloud.supports(CloudCapability.MULTI_NODE, res)
+    feas = cloud.get_feasible_resources(res)
+    assert [f.region for f in feas] == ['main']
+    assert cloud.hourly_cost(feas[0]) == 0.0
+    # Not offered for unpinned requests (sunk-cost $0 would win every
+    # optimization).
+    assert cloud.get_feasible_resources(
+        Resources.from_yaml_config({'cpus': '4'})) == []
+
+
+# ----- provisioner lifecycle -------------------------------------------------
+def test_pod_lifecycle(fake_k8s):
+    record = provision.run_instances('kubernetes',
+                                     _config(cpus='4', memory='8'))
+    assert record.instance_ids == ['k1-0']
+    provision.wait_instances('kubernetes', 'k1', timeout_s=10)
+    statuses = provision.query_instances('kubernetes', 'k1')
+    assert statuses == {'k1-0': InstanceStatus.RUNNING}
+    info = provision.get_cluster_info('kubernetes', 'k1')
+    assert info.instances[0].internal_ips == ['10.1.0.1']
+    pod = fake_k8s.pod('default', 'k1-0')
+    assert pod['metadata']['labels']['skytpu-cluster'] == 'k1'
+    assert pod['spec']['containers'][0]['resources']['requests'] == {
+        'cpu': '4', 'memory': '8Gi'}
+    # stop is a hard no (pods can't stop)
+    with pytest.raises(exceptions.NotSupportedError):
+        provision.stop_instances('kubernetes', 'k1')
+    provision.terminate_instances('kubernetes', 'k1')
+    assert provision.query_instances('kubernetes', 'k1') == {}
+
+
+def test_tpu_slice_renders_gke_selectors(fake_k8s):
+    provision.run_instances('kubernetes',
+                            _config(cluster='ktpu',
+                                    accelerators='tpu-v5litepod-16'))
+    pods = [fake_k8s.pod('default', f'ktpu-{i}') for i in range(4)]
+    # v5litepod-16: 16 chips, 4 chips/host -> 4 host pods, one node.
+    for pod in pods:
+        sel = pod['spec']['nodeSelector']
+        assert sel['cloud.google.com/gke-tpu-accelerator'] == \
+            'tpu-v5-lite-podslice'
+        assert 'x' in sel['cloud.google.com/gke-tpu-topology']
+        limits = pod['spec']['containers'][0]['resources']['limits']
+        assert limits['google.com/tpu'] == '4'
+    # One logical node (the slice), 4 host IPs — the gang executor's
+    # fan-out shape.
+    statuses = provision.query_instances('kubernetes', 'ktpu')
+    assert list(statuses) == ['ktpu-0']
+    info = provision.get_cluster_info('kubernetes', 'ktpu')
+    assert len(info.instances) == 1
+    assert len(info.instances[0].internal_ips) == 4
+
+
+def test_unschedulable_classified_as_stockout(fake_k8s):
+    fake_k8s.set_behavior('unschedulable')
+    provision.run_instances('kubernetes', _config(cluster='kstock',
+                                                  cpus='4'))
+    with pytest.raises(exceptions.InsufficientCapacityError):
+        provision.wait_instances('kubernetes', 'kstock', timeout_s=10)
+    # cleanup happened so a retry elsewhere starts clean
+    assert provision.query_instances('kubernetes', 'kstock') == {}
+
+
+def test_quota_rejected_at_create(fake_k8s):
+    fake_k8s.set_behavior('quota')
+    with pytest.raises(exceptions.QuotaExceededError):
+        provision.run_instances('kubernetes', _config(cluster='kq',
+                                                      cpus='4'))
+
+
+def test_eviction_presents_as_preemption(fake_k8s):
+    provision.run_instances('kubernetes', _config(cluster='kev',
+                                                  cpus='4'))
+    provision.wait_instances('kubernetes', 'kev', timeout_s=10)
+    fake_k8s.evict('default', 'kev-0')
+    statuses = provision.query_instances('kubernetes', 'kev')
+    assert statuses['kev-0'] is InstanceStatus.PREEMPTED
+
+
+def test_one_evicted_host_kills_the_slice(fake_k8s):
+    provision.run_instances('kubernetes',
+                            _config(cluster='kslice',
+                                    accelerators='tpu-v5litepod-16'))
+    provision.wait_instances('kubernetes', 'kslice', timeout_s=10)
+    fake_k8s.evict('default', 'kslice-2')   # one host of four
+    statuses = provision.query_instances('kubernetes', 'kslice')
+    assert statuses['kslice-0'] is InstanceStatus.PREEMPTED
+
+
+def test_rerun_is_idempotent(fake_k8s):
+    provision.run_instances('kubernetes', _config(cluster='ki', cpus='4'))
+    record = provision.run_instances('kubernetes',
+                                     _config(cluster='ki', cpus='4'))
+    assert record.resumed
+    assert len(provision.query_instances('kubernetes', 'ki')) == 1
+
+
+def test_spot_renders_gke_spot_selector(fake_k8s):
+    provision.run_instances('kubernetes',
+                            _config(cluster='ks', cpus='4',
+                                    use_spot=True))
+    pod = fake_k8s.pod('default', 'ks-0')
+    assert pod['spec']['nodeSelector']['cloud.google.com/gke-spot'] == \
+        'true'
+    assert pod['spec']['tolerations'][0]['key'] == \
+        'cloud.google.com/gke-spot'
