@@ -88,6 +88,12 @@ class AgentScheduler:
                           encoding='utf-8') as f:
                     f.write(f'{e}\n')
             finally:
+                # Ship finished-job logs to the configured external
+                # store (no-op when logs.store is unset; never raises).
+                from skypilot_tpu import logs as logs_lib
+                logs_lib.ship_job_logs(
+                    os.environ.get('SKYTPU_CLUSTER_NAME'), job_id,
+                    log_dir)
                 with self._lock:
                     self._current = self._current_id = None
 
@@ -193,6 +199,9 @@ def main() -> None:
     parser.add_argument('--region', default=None)
     parser.add_argument('--zone', default=None)
     args = parser.parse_args()
+    if args.cluster_name:
+        # Visible to the job runner thread (log shipping destination).
+        os.environ['SKYTPU_CLUSTER_NAME'] = args.cluster_name
     identity = autostop_lib.ClusterIdentity(args.cluster_name, args.cloud,
                                             args.region, args.zone)
     web.run_app(make_app(identity=identity), host=args.host, port=args.port,

@@ -109,6 +109,16 @@ class TpuVmBackend(backend_lib.Backend):
             if candidate.cloud != 'local':
                 from skypilot_tpu import authentication
                 _, authorized_key = authentication.get_or_generate_keys()
+            from skypilot_tpu import volumes as volumes_lib
+            try:
+                task_volumes = volumes_lib.validate_task_volumes(
+                    task, candidate)
+            except exceptions.InvalidTaskError as e:
+                # Volume-incompatible *candidate*, not a broken task:
+                # surface inside the failover taxonomy so the engine
+                # moves to the next placement (one of which may host
+                # the volume) instead of aborting the launch.
+                raise exceptions.ProvisionError(str(e)) from e
             config = ProvisionConfig(
                 cluster_name=cluster_name,
                 num_nodes=task.num_nodes,
@@ -118,6 +128,7 @@ class TpuVmBackend(backend_lib.Backend):
                 authorized_key=authorized_key,
                 labels=candidate.labels or {},
                 ports=candidate.ports or [],
+                volumes=task_volumes,
             )
             record = provision_lib.run_instances(candidate.cloud, config)
             provision_lib.wait_instances(candidate.cloud, cluster_name,
@@ -153,8 +164,13 @@ class TpuVmBackend(backend_lib.Backend):
             node_ips=info.node_ips,
             instance_names=result.record.instance_ids,
             ssh_user=info.ssh_user,
-            ssh_key_path=os.path.expanduser('~/.ssh/sky-key')
-            if candidate.cloud != 'local' else None,
+            # Provider-mandated key first (ssh pools carry their own
+            # identity_file — the framework key is never injected on
+            # BYO hosts), else the framework-generated key.
+            ssh_key_path=(os.path.expanduser(info.ssh_key_path)
+                          if info.ssh_key_path else
+                          os.path.expanduser('~/.ssh/sky-key')
+                          if candidate.cloud != 'local' else None),
             agent_port=(common_utils.find_free_port() if candidate.cloud == 'local'
                         else agent_client_lib.AGENT_PORT),
         )

@@ -233,6 +233,45 @@ def check():
 
 
 @cli.group()
+def volumes():
+    """Named persistent volumes (k8s PVCs, GCP disks)."""
+
+
+@volumes.command('apply')
+@click.argument('name')
+@click.option('--type', 'vtype', required=True,
+              type=click.Choice(['k8s-pvc', 'gcp-disk']))
+@click.option('--infra', required=True,
+              help='kubernetes/<ctx> or gcp/<region>/<zone>')
+@click.option('--size', 'size_gb', required=True, type=int,
+              help='Size in GiB')
+def volumes_apply_cmd(name, vtype, infra, size_gb):
+    """Create (or idempotently re-apply) a volume."""
+    vol = sdk.volumes_apply(name, vtype, infra, size_gb)
+    click.echo(f'Volume {vol["name"]!r} ({vol["vtype"]}, '
+               f'{vol["size_gb"]}Gi) ready on {vol["infra"]}.')
+
+
+@volumes.command('ls')
+@click.option('--all-users', '-u', is_flag=True, default=False)
+def volumes_ls_cmd(all_users):
+    """List volumes in the active workspace."""
+    rows = [[v['name'], v['vtype'], v['infra'], v['size_gb'],
+             v['status'], v.get('user_name') or '-']
+            for v in sdk.volumes_list(all_users=all_users)]
+    ux_utils.print_table(
+        ['NAME', 'TYPE', 'INFRA', 'SIZE_GB', 'STATUS', 'USER'], rows)
+
+
+@volumes.command('delete')
+@click.argument('name')
+def volumes_delete_cmd(name):
+    """Delete a volume and its backing store."""
+    sdk.volumes_delete(name)
+    click.echo(f'Volume {name!r} deleted.')
+
+
+@cli.group()
 def jobs():
     """Managed jobs: auto-recovering tasks on preemptible TPU slices."""
 

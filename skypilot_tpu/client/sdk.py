@@ -277,6 +277,24 @@ def serve_replica_logs(service_name: str, replica_id: int,
         out.flush()
 
 
+def volumes_apply(name: str, vtype: str, infra: str, size_gb: int,
+                  config: Optional[Dict[str, Any]] = None
+                  ) -> Dict[str, Any]:
+    body: Dict[str, Any] = {'name': name, 'vtype': vtype, 'infra': infra,
+                            'size_gb': size_gb}
+    if config:
+        body['config'] = config
+    return _post('/volumes/apply', body)
+
+
+def volumes_list(all_users: bool = False) -> List[Dict[str, Any]]:
+    return _get('/volumes', all_users='1' if all_users else '0')
+
+
+def volumes_delete(name: str) -> Dict[str, Any]:
+    return _post('/volumes/delete', {'name': name})
+
+
 def cost_report() -> List[Dict[str, Any]]:
     return _get('/cost_report')
 

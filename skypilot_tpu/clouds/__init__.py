@@ -8,14 +8,16 @@ from skypilot_tpu.clouds.cloud import Cloud, CloudCapability
 from skypilot_tpu.clouds.gcp import GCP
 from skypilot_tpu.clouds.kubernetes import Kubernetes
 from skypilot_tpu.clouds.local import Local
+from skypilot_tpu.clouds.ssh import SSH
 
 __all__ = ['Cloud', 'CloudCapability', 'GCP', 'Kubernetes', 'Local',
-           'get_cloud', 'enabled_clouds', 'CLOUD_REGISTRY']
+           'SSH', 'get_cloud', 'enabled_clouds', 'CLOUD_REGISTRY']
 
 CLOUD_REGISTRY: Dict[str, Cloud] = {
     GCP.NAME: GCP(),
     Kubernetes.NAME: Kubernetes(),
     Local.NAME: Local(),
+    SSH.NAME: SSH(),
 }
 
 

@@ -1,0 +1,89 @@
+"""Log shipping (parity: sky/logs/ — agent.py ships job logs to an
+external store so they survive cluster teardown and feed external
+aggregation).
+
+Config (layered config, shipped to the cluster with the runtime):
+
+    logs:
+      store: gcs            # or 'file'
+      bucket: my-log-bucket # gcs
+      path: /var/skytpu-logs  # file
+      prefix: prod          # optional key prefix
+
+The agent ships each job's log directory when the job reaches a
+terminal state; failures are logged and swallowed (shipping must never
+affect job status).  `file` is both the local-aggregation story and the
+hermetic test path; `gcs` rides data/storage.py's GcsStore (and its
+fake root in tests).
+"""
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+
+def shipping_config() -> Optional[Dict[str, Any]]:
+    store = os.environ.get('SKYTPU_LOG_STORE')
+    if store:
+        return {
+            'store': store,
+            'bucket': os.environ.get('SKYTPU_LOG_BUCKET'),
+            'path': os.environ.get('SKYTPU_LOG_PATH'),
+            'prefix': os.environ.get('SKYTPU_LOG_PREFIX', ''),
+        }
+    from skypilot_tpu import sky_config
+    cfg = sky_config.get_nested(('logs',), None)
+    if not cfg or not cfg.get('store'):
+        return None
+    return dict(cfg)
+
+
+def ship_job_logs(cluster_name: Optional[str], job_id: int,
+                  log_dir: str) -> Optional[str]:
+    """Ship one finished job's logs; returns the destination (or None
+    when shipping is off).  Never raises — it runs in the agent's job
+    loop, where an escaping exception would kill the scheduler thread."""
+    try:
+        cfg = shipping_config()
+        if not isinstance(cfg, dict):
+            return None
+        return _ship(cfg, cluster_name or 'cluster', job_id, log_dir)
+    except Exception as e:  # pylint: disable=broad-except
+        logger.warning(f'log shipping for job {job_id} failed: {e}')
+        return None
+
+
+def _ship(cfg: Dict[str, Any], cluster_name: str, job_id: int,
+          log_dir: str) -> str:
+    prefix = (cfg.get('prefix') or '').strip('/')
+    rel = '/'.join(p for p in (prefix, cluster_name, f'job-{job_id}')
+                   if p)
+    store = cfg['store']
+    if store == 'file':
+        base = os.path.expanduser(cfg.get('path') or '~/skytpu-logs')
+        dst = os.path.join(base, rel)
+        os.makedirs(dst, exist_ok=True)
+        for entry in os.listdir(log_dir):
+            src = os.path.join(log_dir, entry)
+            if os.path.isfile(src):
+                shutil.copy2(src, os.path.join(dst, entry))
+        logger.info(f'job {job_id} logs shipped to {dst}')
+        return dst
+    if store == 'gcs':
+        bucket = cfg.get('bucket')
+        if not bucket:
+            raise ValueError('logs.store gcs needs logs.bucket')
+        from skypilot_tpu.data import storage as storage_lib
+        gcs = storage_lib.GcsStore(bucket)
+        if not gcs.exists():
+            gcs.create()
+        gcs.sync_up(log_dir, prefix=rel)
+        dst = f'gs://{bucket}/{rel}'
+        logger.info(f'job {job_id} logs shipped to {dst}')
+        return dst
+    raise ValueError(f'unknown log store {store!r} (file|gcs)')

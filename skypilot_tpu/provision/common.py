@@ -40,6 +40,10 @@ class ProvisionConfig:
     authorized_key: Optional[str] = None  # pubkey to inject for SSH
     labels: Dict[str, str] = dataclasses.field(default_factory=dict)
     ports: List[str] = dataclasses.field(default_factory=list)
+    # {mount_path: volume_name} — pre-validated named volumes
+    # (skypilot_tpu/volumes.py): k8s renders PVC mounts, GCP attaches
+    # the persistent disk at instance insert.
+    volumes: Dict[str, str] = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass
@@ -61,6 +65,9 @@ class ClusterInfo:
     instances: List[InstanceInfo] = dataclasses.field(default_factory=list)
     ssh_user: str = 'skytpu'
     ssh_port: int = 22
+    # Provider-mandated key (ssh node pools: the pool's identity_file);
+    # None = the framework's own generated key.
+    ssh_key_path: Optional[str] = None
 
     @property
     def node_ips(self) -> List[List[str]]:

@@ -106,7 +106,9 @@ class GceClient:
                        spot: bool,
                        labels: Optional[Dict[str, str]],
                        metadata: Optional[Dict[str, str]],
-                       disk_size_gb: int) -> Dict[str, Any]:
+                       disk_size_gb: int,
+                       attach_disks: Optional[List[str]] = None
+                       ) -> Dict[str, Any]:
         body: Dict[str, Any] = {
             'name': name,
             'machineType': f'zones/{zone}/machineTypes/{machine_type}',
@@ -117,7 +119,14 @@ class GceClient:
                     'sourceImage': _DEFAULT_IMAGE,
                     'diskSizeGb': str(disk_size_gb),
                 },
-            }],
+            }] + [{
+                # Named persistent-disk volumes (volumes.py): attached
+                # non-boot, never auto-deleted — they outlive the VM.
+                'boot': False,
+                'autoDelete': False,
+                'deviceName': disk,
+                'source': f'{self._zone_path(zone)}/disks/{disk}',
+            } for disk in (attach_disks or [])],
             'networkInterfaces': [{
                 'network': 'global/networks/default',
                 'accessConfigs': [{'type': 'ONE_TO_ONE_NAT',
@@ -140,9 +149,10 @@ class GceClient:
                         spot: bool = False,
                         labels: Optional[Dict[str, str]] = None,
                         metadata: Optional[Dict[str, str]] = None,
-                        disk_size_gb: int = 100) -> None:
+                        disk_size_gb: int = 100,
+                        attach_disks: Optional[List[str]] = None) -> None:
         body = self._instance_body(zone, name, machine_type, spot, labels,
-                                   metadata, disk_size_gb)
+                                   metadata, disk_size_gb, attach_disks)
         op = self._request('POST', f'{self._zone_path(zone)}/instances',
                            body=body)
         self.wait_zone_operation(zone, op)
@@ -180,6 +190,33 @@ class GceClient:
         try:
             op = self._request(
                 'DELETE', f'{self._zone_path(zone)}/instances/{name}')
+        except exceptions.ProvisionError as e:
+            if '404' in str(e) or 'not found' in str(e).lower():
+                return
+            raise
+        self.wait_zone_operation(zone, op)
+
+    # ----- persistent disks (volumes.py gcp-disk type) -----------------------
+    def create_disk(self, zone: str, name: str, size_gb: int,
+                    disk_type: str = 'pd-balanced') -> None:
+        op = self._request(
+            'POST', f'{self._zone_path(zone)}/disks',
+            body={
+                'name': name,
+                'sizeGb': str(size_gb),
+                'type': f'{self._zone_path(zone)}/diskTypes/{disk_type}',
+                'labels': {'skytpu-volume': name},
+            })
+        self.wait_zone_operation(zone, op)
+
+    def get_disk(self, zone: str, name: str) -> Dict[str, Any]:
+        return self._request('GET',
+                             f'{self._zone_path(zone)}/disks/{name}')
+
+    def delete_disk(self, zone: str, name: str) -> None:
+        try:
+            op = self._request(
+                'DELETE', f'{self._zone_path(zone)}/disks/{name}')
         except exceptions.ProvisionError as e:
             if '404' in str(e) or 'not found' in str(e).lower():
                 return

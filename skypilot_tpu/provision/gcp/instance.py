@@ -92,6 +92,12 @@ def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
     tpu = res.tpu
     if tpu is None:
         return _run_gce_instances(config, res)
+    if config.volumes:
+        # Loud, not silent: TPU slices have no disk-attach path; data
+        # that must survive the slice belongs on bucket mounts.
+        raise exceptions.InvalidRequestError(
+            'gcp-disk volumes cannot attach to TPU slices; use storage '
+            '(bucket) mounts for checkpoints/datasets on TPUs')
     client = _client()
     zone = config.zone
     existing = _cluster_nodes(client, zone, config.cluster_name)
@@ -176,6 +182,34 @@ def _run_gce_instances(config: common.ProvisionConfig,
     metadata = {}
     if config.authorized_key:
         metadata['ssh-keys'] = f'skytpu:{config.authorized_key}'
+    attach_disks = sorted(config.volumes.values()) or None
+    if attach_disks:
+        # Format-if-new and mount each named disk at its mount_path on
+        # boot (the k8s path gets this from the kubelet; VMs need it
+        # spelled out).
+        lines = ['#!/bin/bash']
+        for mount_path, disk in sorted(config.volumes.items()):
+            dev = f'/dev/disk/by-id/google-{disk}'
+            lines += [
+                f'if ! blkid {dev} >/dev/null 2>&1; then '
+                f'mkfs.ext4 -m 0 -F {dev}; fi',
+                f'mkdir -p {mount_path}',
+                f'mount -o discard,defaults {dev} {mount_path}',
+            ]
+        metadata['startup-script'] = '\n'.join(lines)
+
+    def _check_volumes_attached(inst: dict, name: str) -> None:
+        """An existing instance must already carry every requested
+        volume — new volumes cannot be hot-added to a reused VM."""
+        if not attach_disks:
+            return
+        have = {d.get('deviceName') for d in inst.get('disks', [])}
+        missing = [d for d in attach_disks if d not in have]
+        if missing:
+            raise exceptions.InvalidRequestError(
+                f'instance {name} exists without volumes {missing} '
+                f'attached; `skytpu down` the cluster and relaunch to '
+                f'attach them')
 
     instance_ids = []
     to_create = []
@@ -186,18 +220,21 @@ def _run_gce_instances(config: common.ProvisionConfig,
         inst = existing.get(name)
         status = inst.get('status') if inst else None
         if status in ('RUNNING', 'PROVISIONING', 'STAGING'):
+            _check_volumes_attached(inst, name)
             resumed = True
             continue
         if status in ('TERMINATED', 'STOPPING'):
             # GCE TERMINATED == stopped-with-disk: restart in place.  An
             # in-flight stop must settle first — start on a STOPPING
             # instance is a 400 on the real API.
+            _check_volumes_attached(inst, name)
             if status == 'STOPPING':
                 client.wait_instance_status(zone, name, ('TERMINATED',))
             client.start_instance(zone, name)
             resumed = True
             continue
         if status in ('SUSPENDED', 'SUSPENDING'):
+            _check_volumes_attached(inst, name)
             if status == 'SUSPENDING':
                 client.wait_instance_status(zone, name, ('SUSPENDED',))
             client.resume_instance(zone, name)
@@ -207,8 +244,15 @@ def _run_gce_instances(config: common.ProvisionConfig,
     if len(to_create) == 1:
         client.create_instance(zone, to_create[0], machine_type,
                                spot=res.use_spot, labels=labels,
-                               metadata=metadata)
+                               metadata=metadata,
+                               attach_disks=attach_disks)
     elif to_create:
+        if attach_disks:
+            # A zonal persistent disk attaches to one VM (ReadWriteOnce);
+            # multi-node gangs must use bucket mounts instead.
+            raise exceptions.InvalidRequestError(
+                'gcp-disk volumes attach to single-node clusters only; '
+                'use storage (bucket) mounts for multi-node tasks')
         client.bulk_create_instances(zone, to_create, machine_type,
                                      spot=res.use_spot, labels=labels,
                                      metadata=metadata)

@@ -208,6 +208,16 @@ def _pod_spec(config: common.ProvisionConfig, index: int, node: int,
             'operator': 'Equal', 'value': 'true',
             'effect': 'NoSchedule',
         }]
+    if config.volumes:
+        # Named PVCs from the volume registry (skypilot_tpu/volumes.py).
+        container['volumeMounts'] = [
+            {'name': f'vol-{i}', 'mountPath': mount_path}
+            for i, mount_path in enumerate(sorted(config.volumes))]
+        spec['volumes'] = [
+            {'name': f'vol-{i}',
+             'persistentVolumeClaim': {
+                 'claimName': config.volumes[mount_path]}}
+            for i, mount_path in enumerate(sorted(config.volumes))]
     return {
         'apiVersion': 'v1',
         'kind': 'Pod',
@@ -290,6 +300,20 @@ def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
     live = {common.InstanceStatus.RUNNING, common.InstanceStatus.PENDING}
     # A TPU slice node is one pod per host (GKE multi-host slices).
     pods_per_node = res.hosts_per_node if res.is_tpu else 1
+    if config.volumes and config.num_nodes * pods_per_node > 1:
+        # A ReadWriteOnce PVC multi-attached across nodes wedges the
+        # second pod in ContainerCreating until the wait timeout; fail
+        # fast like the GCP disk path does.
+        from skypilot_tpu import volumes as volumes_lib
+        for vol_name in config.volumes.values():
+            vol = volumes_lib.get(vol_name)
+            mode = (vol.config.get('access_mode', 'ReadWriteOnce')
+                    if vol else 'ReadWriteOnce')
+            if mode != 'ReadWriteMany':
+                raise exceptions.InvalidRequestError(
+                    f'volume {vol_name!r} is {mode}; multi-pod tasks '
+                    f'need access_mode ReadWriteMany (or use bucket '
+                    f'mounts)')
     instance_ids = []
     resumed = any(_pod_status(p) in live for p in existing.values())
     for node in range(config.num_nodes):

@@ -537,6 +537,38 @@ def make_app() -> web.Application:
             return web.json_response({'error': 'replica logs unavailable'},
                                      status=404)
 
+    # ----- volumes -----------------------------------------------------------
+    async def volumes_apply(request):
+        body = await _json_body(request, 'volumes_apply')
+        from skypilot_tpu import volumes as volumes_lib
+
+        def work():
+            vol = volumes_lib.apply(body['name'], body['vtype'],
+                                    body['infra'], body['size_gb'],
+                                    body.get('config'))
+            return dataclasses.asdict(vol)
+
+        result = await asyncio.get_event_loop().run_in_executor(
+            None, _with_identity(request, work))
+        return web.json_response(result)
+
+    async def volumes_list(request):
+        from skypilot_tpu import volumes as volumes_lib
+        all_users = request.query.get('all_users', '0') == '1'
+        vols = await asyncio.get_event_loop().run_in_executor(
+            None, _with_identity(
+                request,
+                lambda: volumes_lib.list_volumes(all_users=all_users)))
+        return web.json_response([dataclasses.asdict(v) for v in vols])
+
+    async def volumes_delete(request):
+        body = await _json_body(request, 'volumes_delete')
+        from skypilot_tpu import volumes as volumes_lib
+        await asyncio.get_event_loop().run_in_executor(
+            None, _with_identity(
+                request, lambda: volumes_lib.delete(body['name'])))
+        return web.json_response({'deleted': body['name']})
+
     async def cost_report(request):
         all_users = request.query.get('all_users', '0') == '1'
         report = await asyncio.get_event_loop().run_in_executor(
@@ -586,6 +618,9 @@ def make_app() -> web.Application:
     app.router.add_get('/serve/status', serve_status)
     app.router.add_get('/serve/logs/{service}/{replica_id}',
                        serve_replica_logs)
+    app.router.add_post('/volumes/apply', volumes_apply)
+    app.router.add_get('/volumes', volumes_list)
+    app.router.add_post('/volumes/delete', volumes_delete)
     app.router.add_get('/cost_report', cost_report)
     app.router.add_get('/accelerators', accelerators)
     app.router.add_get('/check', check)

@@ -80,6 +80,24 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
         'properties': {'task': _TASK, 'name': {'type': ['string', 'null']}},
         'additionalProperties': False,
     },
+    'volumes_apply': {
+        'type': 'object',
+        'required': ['name', 'vtype', 'infra', 'size_gb'],
+        'properties': {
+            'name': _NAME,
+            'vtype': {'enum': ['k8s-pvc', 'gcp-disk']},
+            'infra': _NAME,
+            'size_gb': {'type': 'integer', 'minimum': 1},
+            'config': {'type': 'object'},
+        },
+        'additionalProperties': False,
+    },
+    'volumes_delete': {
+        'type': 'object',
+        'required': ['name'],
+        'properties': {'name': _NAME},
+        'additionalProperties': False,
+    },
     'serve_down': {
         'type': 'object',
         'required': ['name'],

@@ -38,6 +38,7 @@ class Task:
         file_mounts: Optional[Dict[str, str]] = None,
         storage_mounts: Optional[Dict[str, Any]] = None,
         service: Optional[Dict[str, Any]] = None,
+        volumes: Optional[Dict[str, str]] = None,
     ) -> None:
         self.name = name
         self.setup = setup
@@ -51,6 +52,9 @@ class Task:
         # skypilot_tpu.data.storage at sync time.
         self.storage_mounts = dict(storage_mounts or {})
         self.service = service
+        # {mount_path: volume_name} — named volumes from the registry
+        # (skypilot_tpu/volumes.py), validated at launch.
+        self.volumes = dict(volumes or {})
         self.resources: Set[resources_lib.Resources] = {
             resources_lib.Resources()
         }
@@ -161,6 +165,7 @@ class Task:
                 if isinstance(v, dict)
             },
             service=config.get('service'),
+            volumes=config.get('volumes'),
         )
         res_config = config.get('resources')
         if res_config is not None:
@@ -219,6 +224,8 @@ class Task:
             out['secrets'] = dict(self._secrets)
         if self.service:
             out['service'] = self.service
+        if self.volumes:
+            out['volumes'] = dict(self.volumes)
         return out
 
     # ----- DAG sugar ---------------------------------------------------------

@@ -118,6 +118,10 @@ TASK_SCHEMA: Dict[str, Any] = {
             }
         },
         'service': _SERVICE_SCHEMA,
+        'volumes': {
+            'type': 'object',
+            'additionalProperties': {'type': 'string'},
+        },
     },
 }
 

@@ -17,6 +17,7 @@ from typing import Dict
 class _State:
     def __init__(self):
         self.instances: Dict[str, dict] = {}        # key: zone/name
+        self.disks: Dict[str, dict] = {}            # key: zone/name
         self.zone_behavior: Dict[str, str] = {}
         self.lock = threading.Lock()
 
@@ -93,6 +94,12 @@ class FakeGceApi:
 
             def do_GET(self):
                 path = self.path.split('?')[0]
+                m = re.match(r'.*/zones/([^/]+)/disks/([^/]+)$', path)
+                if m:
+                    disk = state.disks.get(f'{m.group(1)}/{m.group(2)}')
+                    if disk is None:
+                        return self._error(404, 'disk not found')
+                    return self._send(200, disk)
                 m = re.match(r'.*/zones/([^/]+)/instances/?([^/]*)$', path)
                 if m and m.group(2):
                     inst = state.instances.get(
@@ -112,6 +119,14 @@ class FakeGceApi:
 
             def do_POST(self):
                 path = self.path.split('?')[0]
+                m = re.match(r'.*/zones/([^/]+)/disks$', path)
+                if m:
+                    body = self._body()
+                    key = f'{m.group(1)}/{body["name"]}'
+                    with state.lock:
+                        body['status'] = 'READY'
+                        state.disks[key] = body
+                    return self._op()
                 m = re.match(r'.*/zones/([^/]+)/instances$', path)
                 if m:
                     zone = m.group(1)
@@ -162,6 +177,14 @@ class FakeGceApi:
 
             def do_DELETE(self):
                 path = self.path.split('?')[0]
+                m = re.match(r'.*/zones/([^/]+)/disks/([^/]+)$', path)
+                if m:
+                    key = f'{m.group(1)}/{m.group(2)}'
+                    with state.lock:
+                        if key not in state.disks:
+                            return self._error(404, 'disk not found')
+                        state.disks.pop(key)
+                    return self._op()
                 m = re.match(r'.*/zones/([^/]+)/instances/([^/]+)$', path)
                 if m:
                     with state.lock:

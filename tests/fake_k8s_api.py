@@ -20,6 +20,7 @@ from typing import Dict
 class _State:
     def __init__(self):
         self.pods: Dict[str, dict] = {}     # key: ns/name
+        self.pvcs: Dict[str, dict] = {}     # key: ns/name
         self.behavior = 'ok'
         self.next_ip = 1
         self.lock = threading.Lock()
@@ -48,6 +49,9 @@ class FakeK8sApi:
 
     def pod(self, namespace: str, name: str) -> dict:
         return self.state.pods[f'{namespace}/{name}']
+
+    def pvc(self, namespace: str, name: str) -> dict:
+        return self.state.pvcs[f'{namespace}/{name}']
 
     def evict(self, namespace: str, name: str):
         """Spot reclaim: the pod fails with reason Evicted."""
@@ -93,8 +97,20 @@ class FakeK8sApi:
                         if length else {})
 
             def do_POST(self):
-                m = re.match(r'^/api/v1/namespaces/([^/]+)/pods$',
-                             self.path.split('?')[0])
+                path = self.path.split('?')[0]
+                m = re.match(
+                    r'^/api/v1/namespaces/([^/]+)/'
+                    r'persistentvolumeclaims$', path)
+                if m:
+                    pvc = self._body()
+                    key = f'{m.group(1)}/{pvc["metadata"]["name"]}'
+                    with state.lock:
+                        if key in state.pvcs:
+                            return self._status(409, 'already exists')
+                        pvc['status'] = {'phase': 'Bound'}
+                        state.pvcs[key] = pvc
+                    return self._send(201, pvc)
+                m = re.match(r'^/api/v1/namespaces/([^/]+)/pods$', path)
                 if not m:
                     return self._status(404, f'unknown POST {self.path}')
                 ns = m.group(1)
@@ -161,8 +177,19 @@ class FakeK8sApi:
                 return self._status(404, f'unknown GET {path}')
 
             def do_DELETE(self):
+                path = self.path.split('?')[0]
+                m = re.match(
+                    r'^/api/v1/namespaces/([^/]+)/'
+                    r'persistentvolumeclaims/([^/]+)$', path)
+                if m:
+                    key = f'{m.group(1)}/{m.group(2)}'
+                    with state.lock:
+                        pvc = state.pvcs.pop(key, None)
+                    if pvc is None:
+                        return self._status(404, 'pvc not found')
+                    return self._send(200, pvc)
                 m = re.match(r'^/api/v1/namespaces/([^/]+)/pods/([^/]+)$',
-                             self.path.split('?')[0])
+                             path)
                 if not m:
                     return self._status(404,
                                         f'unknown DELETE {self.path}')

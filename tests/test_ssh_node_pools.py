@@ -1,0 +1,125 @@
+"""SSH node pools: bring-your-own hosts behind the provision API
+(parity: sky/ssh_node_pools/)."""
+import socket
+import threading
+
+import pytest
+
+from skypilot_tpu import clouds as clouds_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import provision
+from skypilot_tpu import ssh_node_pools
+from skypilot_tpu.provision import InstanceStatus, ProvisionConfig
+from skypilot_tpu.resources import Resources
+
+
+@pytest.fixture
+def tcp_listener():
+    """A live TCP port standing in for sshd."""
+    srv = socket.socket()
+    srv.bind(('0.0.0.0', 0))     # reachable via any 127.0.0.x alias
+    srv.listen(16)
+    port = srv.getsockname()[1]
+
+    def accept_loop():
+        while True:
+            try:
+                conn, _ = srv.accept()
+                conn.close()
+            except OSError:
+                return
+
+    threading.Thread(target=accept_loop, daemon=True).start()
+    yield port
+    srv.close()
+
+
+@pytest.fixture
+def pool(tmp_home, tcp_listener):
+    path = tmp_home / '.skytpu' / 'ssh_node_pools.yaml'
+    path.parent.mkdir(exist_ok=True)
+    path.write_text(f'''
+lab:
+  user: ubuntu
+  port: {tcp_listener}
+  hosts: [127.0.0.1, 127.0.0.2, 127.0.0.3]
+small:
+  port: 1
+  hosts: [127.0.0.9]
+''')
+    return path
+
+
+def _config(cluster, pool_name='lab', num_nodes=1):
+    return ProvisionConfig(cluster_name=cluster, num_nodes=num_nodes,
+                           resources_config={'cpus': '2',
+                                             'infra': f'ssh/{pool_name}'},
+                           region=pool_name)
+
+
+def test_pool_parsing_and_usage(pool):
+    pools = ssh_node_pools.load_pools()
+    assert pools['lab']['user'] == 'ubuntu'
+    assert len(pools['lab']['hosts']) == 3
+    assert ssh_node_pools.pool_usage() == [
+        {'pool': 'lab', 'hosts': 3, 'in_use': 0, 'clusters': []},
+        {'pool': 'small', 'hosts': 1, 'in_use': 0, 'clusters': []},
+    ]
+
+
+def test_allocate_lifecycle(pool):
+    record = provision.run_instances('ssh', _config('c1', num_nodes=2))
+    assert record.instance_ids == ['127.0.0.1', '127.0.0.2']
+    provision.wait_instances('ssh', 'c1', region='lab')
+    statuses = provision.query_instances('ssh', 'c1', region='lab')
+    assert all(s is InstanceStatus.RUNNING for s in statuses.values())
+    info = provision.get_cluster_info('ssh', 'c1', region='lab')
+    assert info.ssh_user == 'ubuntu'
+    assert info.node_ips == [['127.0.0.1'], ['127.0.0.2']]
+    # idempotent re-run
+    again = provision.run_instances('ssh', _config('c1', num_nodes=2))
+    assert again.resumed and again.instance_ids == record.instance_ids
+    # second cluster takes the remaining host; a third request stocks out
+    provision.run_instances('ssh', _config('c2'))
+    with pytest.raises(exceptions.InsufficientCapacityError):
+        provision.run_instances('ssh', _config('c3'))
+    # release frees capacity
+    provision.terminate_instances('ssh', 'c1', region='lab')
+    record3 = provision.run_instances('ssh', _config('c3', num_nodes=2))
+    assert len(record3.instance_ids) == 2
+    usage = ssh_node_pools.pool_usage('lab')[0]
+    assert usage['in_use'] == 3
+    assert usage['clusters'] == ['c2', 'c3']
+
+
+def test_dead_host_is_terminated_and_wait_fails_over(pool, tmp_home):
+    # 127.0.0.9 has no listener on the pool port -> dead.
+    provision.run_instances('ssh', _config('cd', pool_name='small'))
+    statuses = provision.query_instances('ssh', 'cd', region='small')
+    assert statuses['127.0.0.9'] is InstanceStatus.TERMINATED
+    with pytest.raises(exceptions.InsufficientCapacityError):
+        provision.wait_instances('ssh', 'cd', region='small')
+    # wait released the allocation for failover
+    assert ssh_node_pools.allocation('small', 'cd') == []
+
+
+def test_cloud_layer(pool):
+    cloud = clouds_lib.get_cloud('ssh')
+    ok, _ = cloud.check_credentials()
+    assert ok
+    res = Resources.from_yaml_config({'infra': 'ssh', 'cpus': '2'})
+    feas = cloud.get_feasible_resources(res)
+    assert sorted(f.region for f in feas) == ['lab', 'small']
+    pinned = Resources.from_yaml_config({'infra': 'ssh/lab'})
+    assert [f.region for f in cloud.get_feasible_resources(pinned)] == \
+        ['lab']
+    assert cloud.get_feasible_resources(
+        Resources.from_yaml_config({'cpus': '2'})) == []
+    tpu = Resources.from_yaml_config({'infra': 'ssh',
+                                      'accelerators': 'tpu-v5p-8'})
+    assert cloud.get_feasible_resources(tpu) == []
+
+
+def test_unknown_pool_errors(pool):
+    with pytest.raises(exceptions.InvalidInfraError):
+        provision.run_instances('ssh', _config('cx', pool_name='nope'))

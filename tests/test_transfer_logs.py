@@ -1,0 +1,130 @@
+"""Cross-cloud transfer (parity: sky/data/data_transfer.py) and log
+shipping (parity: sky/logs/agent.py), hermetic via fake store roots."""
+import time
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.data import transfer
+
+
+@pytest.fixture
+def fake_stores(tmp_home, monkeypatch):
+    gcs = tmp_home / 'fake-gcs'
+    s3 = tmp_home / 'fake-s3'
+    gcs.mkdir()
+    s3.mkdir()
+    monkeypatch.setenv('SKYTPU_FAKE_GCS_ROOT', str(gcs))
+    monkeypatch.setenv('SKYTPU_FAKE_S3_ROOT', str(s3))
+    return {'gcs': gcs, 's3': s3}
+
+
+def _seed(root, bucket, files):
+    d = root / bucket
+    d.mkdir(parents=True, exist_ok=True)
+    for name, content in files.items():
+        (d / name).write_text(content)
+
+
+# ----- transfer --------------------------------------------------------------
+def test_s3_to_gcs_relay(fake_stores):
+    _seed(fake_stores['s3'], 'src-bucket/data',
+          {'a.txt': 'alpha', 'b.txt': 'beta'})
+    transfer.transfer('s3://src-bucket/data', 'gs://dst-bucket/data')
+    dst = fake_stores['gcs'] / 'dst-bucket' / 'data'
+    assert (dst / 'a.txt').read_text() == 'alpha'
+    assert (dst / 'b.txt').read_text() == 'beta'
+
+
+def test_gcs_to_s3_relay(fake_stores):
+    _seed(fake_stores['gcs'], 'gb/ckpt', {'w.bin': 'weights'})
+    transfer.transfer('gs://gb/ckpt', 's3://sb/ckpt')
+    assert (fake_stores['s3'] / 'sb' / 'ckpt' / 'w.bin').read_text() == \
+        'weights'
+
+
+def test_local_up_and_down(fake_stores, tmp_home):
+    src = tmp_home / 'localdata'
+    src.mkdir()
+    (src / 'f.txt').write_text('local')
+    transfer.transfer(str(src), 'gs://lb/up')
+    assert (fake_stores['gcs'] / 'lb' / 'up' / 'f.txt').read_text() == \
+        'local'
+    down = tmp_home / 'down'
+    transfer.transfer('gs://lb/up', str(down))
+    assert (down / 'f.txt').read_text() == 'local'
+
+
+def test_bad_scheme_rejected(fake_stores):
+    with pytest.raises(exceptions.StorageError):
+        transfer.transfer('ftp://x/y', 'gs://b/c')
+
+
+# ----- log shipping ----------------------------------------------------------
+def test_ship_job_logs_file_store(tmp_home, monkeypatch):
+    from skypilot_tpu import logs as logs_lib
+    sink = tmp_home / 'logsink'
+    monkeypatch.setenv('SKYTPU_LOG_STORE', 'file')
+    monkeypatch.setenv('SKYTPU_LOG_PATH', str(sink))
+    monkeypatch.setenv('SKYTPU_LOG_PREFIX', 'prod')
+    log_dir = tmp_home / 'joblogs'
+    log_dir.mkdir()
+    (log_dir / 'run-0.log').write_text('hello from rank 0')
+    dst = logs_lib.ship_job_logs('my-cluster', 7, str(log_dir))
+    assert dst == str(sink / 'prod' / 'my-cluster' / 'job-7')
+    assert (sink / 'prod' / 'my-cluster' / 'job-7' /
+            'run-0.log').read_text() == 'hello from rank 0'
+
+
+def test_ship_job_logs_gcs_store(tmp_home, monkeypatch):
+    from skypilot_tpu import logs as logs_lib
+    gcs = tmp_home / 'fake-gcs'
+    gcs.mkdir()
+    monkeypatch.setenv('SKYTPU_FAKE_GCS_ROOT', str(gcs))
+    monkeypatch.setenv('SKYTPU_LOG_STORE', 'gcs')
+    monkeypatch.setenv('SKYTPU_LOG_BUCKET', 'logbkt')
+    log_dir = tmp_home / 'joblogs'
+    log_dir.mkdir()
+    (log_dir / 'run-0.log').write_text('gcs log line')
+    dst = logs_lib.ship_job_logs('c', 3, str(log_dir))
+    assert dst == 'gs://logbkt/c/job-3'
+    shipped = gcs / 'logbkt' / 'c' / 'job-3' / 'run-0.log'
+    assert shipped.read_text() == 'gcs log line'
+
+
+def test_shipping_never_raises(tmp_home, monkeypatch):
+    from skypilot_tpu import logs as logs_lib
+    monkeypatch.setenv('SKYTPU_LOG_STORE', 'gcs')   # no bucket -> error
+    assert logs_lib.ship_job_logs('c', 1, '/nonexistent') is None
+
+
+def test_shipping_off_by_default(tmp_home):
+    from skypilot_tpu import logs as logs_lib
+    assert logs_lib.shipping_config() is None
+    assert logs_lib.ship_job_logs('c', 1, '/tmp') is None
+
+
+def test_agent_ships_on_job_completion(tmp_home, enable_all_clouds,
+                                       monkeypatch):
+    """E2e: a local-cloud job finishes and its logs land in the sink."""
+    sink = tmp_home / 'sink'
+    monkeypatch.setenv('SKYTPU_LOG_STORE', 'file')
+    monkeypatch.setenv('SKYTPU_LOG_PATH', str(sink))
+    from skypilot_tpu import execution
+    from skypilot_tpu.resources import Resources
+    from skypilot_tpu.task import Task
+    task = Task('ship', run='echo shipped-line')
+    task.set_resources(Resources.from_yaml_config({'infra': 'local'}))
+    job_id, _ = execution.launch(task, 'shipc', detach_run=False)
+    deadline = time.time() + 15
+    shipped = None
+    while time.time() < deadline:
+        hits = list(sink.rglob('run-0.log'))
+        if hits:
+            shipped = hits[0]
+            break
+        time.sleep(0.2)
+    assert shipped is not None, 'logs never shipped'
+    assert 'shipped-line' in shipped.read_text()
+    assert f'job-{job_id}' in str(shipped)
+    assert 'shipc' in str(shipped)

@@ -1,0 +1,206 @@
+"""Volumes: registry + k8s PVC / GCP disk backing stores + task
+attachment (parity: sky/volumes/)."""
+import pytest
+import requests as requests_lib
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import volumes
+from skypilot_tpu.provision import ProvisionConfig
+
+from tests.test_api_server import api_server, _mk_local_task  # noqa: F401
+from tests.test_kubernetes import fake_k8s  # noqa: F401
+
+
+@pytest.fixture
+def fake_gce(tmp_home, monkeypatch):
+    from tests.fake_gce_api import FakeGceApi
+    fake = FakeGceApi()
+    monkeypatch.setenv('SKYTPU_GCE_API_ENDPOINT', fake.endpoint)
+    monkeypatch.setenv('SKYTPU_GCP_PROJECT', 'proj')
+    yield fake
+    fake.close()
+
+
+# ----- lifecycle -------------------------------------------------------------
+def test_pvc_volume_lifecycle(tmp_home, fake_k8s):
+    vol = volumes.apply('data', 'k8s-pvc', 'kubernetes/main', 50)
+    assert vol.status == 'READY'
+    pvc = fake_k8s.pvc('default', 'data')
+    assert pvc['spec']['resources']['requests']['storage'] == '50Gi'
+    assert [v.name for v in volumes.list_volumes()] == ['data']
+    # idempotent re-apply
+    volumes.apply('data', 'k8s-pvc', 'kubernetes/main', 50)
+    # conflicting spec rejected
+    with pytest.raises(exceptions.InvalidRequestError):
+        volumes.apply('data', 'k8s-pvc', 'kubernetes/main', 100)
+    volumes.delete('data')
+    assert volumes.list_volumes() == []
+    with pytest.raises(KeyError):
+        fake_k8s.pvc('default', 'data')
+
+
+def test_gcp_disk_volume_lifecycle(tmp_home, fake_gce):
+    volumes.apply('ckpt', 'gcp-disk', 'gcp/us-central1/us-central1-a',
+                  200)
+    disk = fake_gce.state.disks['us-central1-a/ckpt']
+    assert disk['sizeGb'] == '200'
+    volumes.delete('ckpt')
+    assert 'us-central1-a/ckpt' not in fake_gce.state.disks
+
+
+def test_validation(tmp_home):
+    with pytest.raises(exceptions.InvalidRequestError):
+        volumes.apply('x', 'nfs', 'gcp/r/z', 10)
+    with pytest.raises(exceptions.InvalidRequestError):
+        volumes.apply('x', 'k8s-pvc', 'gcp/us-central1', 10)
+    with pytest.raises(exceptions.InvalidRequestError):
+        volumes.apply('x', 'gcp-disk', 'gcp/us-central1', 10)  # no zone
+    with pytest.raises(exceptions.StorageError):
+        volumes.delete('missing')
+
+
+# ----- task attachment -------------------------------------------------------
+def test_k8s_pod_mounts_pvc(tmp_home, fake_k8s):
+    from skypilot_tpu import provision
+    volumes.apply('data', 'k8s-pvc', 'kubernetes/main', 10)
+    cfg = ProvisionConfig(
+        cluster_name='kv', num_nodes=1,
+        resources_config={'cpus': '2', 'infra': 'kubernetes/main'},
+        region='main', volumes={'/mnt/data': 'data'})
+    provision.run_instances('kubernetes', cfg)
+    pod = fake_k8s.pod('default', 'kv-0')
+    assert pod['spec']['volumes'][0]['persistentVolumeClaim'][
+        'claimName'] == 'data'
+    assert pod['spec']['containers'][0]['volumeMounts'][0][
+        'mountPath'] == '/mnt/data'
+
+
+def test_task_volume_validation(tmp_home, fake_k8s):
+    from skypilot_tpu.resources import Resources
+    from skypilot_tpu.task import Task
+    volumes.apply('data', 'k8s-pvc', 'kubernetes/main', 10)
+    task = Task('t', run='echo x', volumes={'/mnt/data': 'data'})
+    placement_ok = Resources.from_yaml_config(
+        {'infra': 'kubernetes/main'})
+    assert volumes.validate_task_volumes(task, placement_ok) == {
+        '/mnt/data': 'data'}
+    # wrong cloud
+    with pytest.raises(exceptions.InvalidTaskError):
+        volumes.validate_task_volumes(
+            task, Resources.from_yaml_config({'infra': 'gcp/us-central1'}))
+    # wrong context
+    with pytest.raises(exceptions.InvalidTaskError):
+        volumes.validate_task_volumes(
+            task, Resources.from_yaml_config({'infra': 'kubernetes/other'}))
+    # unknown volume
+    bad = Task('t2', run='echo', volumes={'/mnt/x': 'nope'})
+    with pytest.raises(exceptions.InvalidTaskError):
+        volumes.validate_task_volumes(bad, placement_ok)
+
+
+def test_gce_mounts_disk_via_startup_script(tmp_home, fake_gce):
+    from skypilot_tpu import provision
+    volumes.apply('d1', 'gcp-disk', 'gcp/us-central1/us-central1-a', 10)
+    cfg = ProvisionConfig(
+        cluster_name='gv', num_nodes=1,
+        resources_config={'cpus': '4',
+                          'infra': 'gcp/us-central1/us-central1-a'},
+        region='us-central1', zone='us-central1-a',
+        volumes={'/mnt/data': 'd1'})
+    provision.run_instances('gcp', cfg)
+    inst = fake_gce.instance('us-central1-a', 'gv-0')
+    disks = {d.get('deviceName') for d in inst['disks']}
+    assert 'd1' in disks
+    script = next(i['value'] for i in inst['metadata']['items']
+                  if i['key'] == 'startup-script')
+    assert 'mkfs.ext4' in script and 'mount' in script
+    assert '/mnt/data' in script
+    # Relaunch with an extra volume on the live instance: loud error.
+    volumes.apply('d2', 'gcp-disk', 'gcp/us-central1/us-central1-a', 10)
+    cfg2 = ProvisionConfig(
+        cluster_name='gv', num_nodes=1,
+        resources_config=cfg.resources_config,
+        region='us-central1', zone='us-central1-a',
+        volumes={'/mnt/data': 'd1', '/mnt/more': 'd2'})
+    with pytest.raises(exceptions.InvalidRequestError):
+        provision.run_instances('gcp', cfg2)
+
+
+def test_tpu_slice_rejects_volumes(tmp_home, fake_gce, monkeypatch):
+    from tests.fake_tpu_api import FakeTpuApi
+    fake_tpu = FakeTpuApi()
+    monkeypatch.setenv('SKYTPU_TPU_API_ENDPOINT', fake_tpu.endpoint)
+    from skypilot_tpu import provision
+    cfg = ProvisionConfig(
+        cluster_name='tv', num_nodes=1,
+        resources_config={'accelerators': 'tpu-v5litepod-8',
+                          'infra': 'gcp/us-central1/us-central1-a'},
+        region='us-central1', zone='us-central1-a',
+        volumes={'/mnt/x': 'whatever'})
+    with pytest.raises(exceptions.InvalidRequestError):
+        provision.run_instances('gcp', cfg)
+    fake_tpu.close()
+
+
+def test_multi_pod_rejects_rwo_pvc(tmp_home, fake_k8s):
+    from skypilot_tpu import provision
+    volumes.apply('rwo', 'k8s-pvc', 'kubernetes/main', 10)
+    cfg = ProvisionConfig(
+        cluster_name='km', num_nodes=2,
+        resources_config={'cpus': '2', 'infra': 'kubernetes/main'},
+        region='main', volumes={'/mnt/d': 'rwo'})
+    with pytest.raises(exceptions.InvalidRequestError):
+        provision.run_instances('kubernetes', cfg)
+    # ReadWriteMany is allowed across pods.
+    volumes.apply('rwx', 'k8s-pvc', 'kubernetes/main', 10,
+                  config={'access_mode': 'ReadWriteMany'})
+    cfg2 = ProvisionConfig(
+        cluster_name='km2', num_nodes=2,
+        resources_config={'cpus': '2', 'infra': 'kubernetes/main'},
+        region='main', volumes={'/mnt/d': 'rwx'})
+    provision.run_instances('kubernetes', cfg2)
+
+
+def test_zone_mismatch_rejected(tmp_home, fake_gce):
+    from skypilot_tpu.resources import Resources
+    from skypilot_tpu.task import Task
+    volumes.apply('zd', 'gcp-disk', 'gcp/us-central1/us-central1-a', 10)
+    task = Task('t', run='echo', volumes={'/mnt/d': 'zd'})
+    with pytest.raises(exceptions.InvalidTaskError):
+        volumes.validate_task_volumes(
+            task, Resources.from_yaml_config(
+                {'infra': 'gcp/us-central1/us-central1-b'}))
+
+
+def test_task_yaml_roundtrip_volumes(tmp_home):
+    from skypilot_tpu.task import Task
+    cfg = {'name': 'v', 'run': 'echo', 'volumes': {'/mnt/d': 'data'}}
+    task = Task.from_yaml_config(cfg)
+    assert task.volumes == {'/mnt/d': 'data'}
+    assert task.to_yaml_config()['volumes'] == {'/mnt/d': 'data'}
+
+
+# ----- REST + workspace scoping ----------------------------------------------
+def test_volumes_over_rest(api_server, tmp_home, fake_k8s):
+    resp = requests_lib.post(
+        f'{api_server}/volumes/apply',
+        json={'name': 'rv', 'vtype': 'k8s-pvc',
+              'infra': 'kubernetes/main', 'size_gb': 5})
+    assert resp.status_code == 200, resp.text
+    assert resp.json()['name'] == 'rv'
+    vols = requests_lib.get(f'{api_server}/volumes').json()
+    assert [v['name'] for v in vols] == ['rv']
+    resp = requests_lib.post(f'{api_server}/volumes/delete',
+                             json={'name': 'rv'})
+    assert resp.status_code == 200
+    assert requests_lib.get(f'{api_server}/volumes').json() == []
+
+
+def test_volume_workspace_scoping(tmp_home, fake_k8s):
+    from skypilot_tpu import workspaces
+    volumes.apply('wsv', 'k8s-pvc', 'kubernetes/main', 5)
+    with workspaces.override('other'):
+        assert volumes.list_volumes() == []
+        with pytest.raises(exceptions.StorageError):
+            volumes.delete('wsv')
+    volumes.delete('wsv')
