@@ -126,8 +126,10 @@ def make_app(scheduler: Optional[AgentScheduler] = None,
     app.on_cleanup.append(_stop_event)
 
     async def health(request):
+        import skypilot_tpu
         return web.json_response({
             'ok': True,
+            'version': skypilot_tpu.__version__,
             'idle_seconds': autostop_lib.idle_seconds(started_at),
             'autostop': autostop_lib.get_config(),
         })

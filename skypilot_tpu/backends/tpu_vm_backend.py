@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import os
 import shlex
+import signal
 import subprocess
 import sys
 import time
@@ -60,6 +61,12 @@ class TpuVmBackend(backend_lib.Backend):
                         'name or down it first.')
                 if existing['status'] is ClusterStatus.UP:
                     logger.info(f'Reusing cluster {cluster_name!r}.')
+                    # Runtime version pin: a client upgraded since this
+                    # cluster launched must not submit jobs to an old
+                    # agent — re-ship the runtime and restart the agent
+                    # first (parity: the reference pins its wheel
+                    # version, sky/backends/wheel_utils.py).
+                    self._ensure_agent_version(handle)
                     return handle
                 # STOPPED/INIT: restart in place — same cloud/zone, so the
                 # existing nodes are reused instead of orphaned by a fresh
@@ -68,6 +75,41 @@ class TpuVmBackend(backend_lib.Backend):
             return self._provision_locked(task, cluster_name,
                                           blocked_resources,
                                           retry_until_up=retry_until_up)
+
+    def _ensure_agent_version(self, handle: ClusterHandle) -> None:
+        """Re-bootstrap the agent when its runtime version differs from
+        this client's (version drift on a long-lived cluster)."""
+        import skypilot_tpu
+        client = self._agent_client(handle)
+        try:
+            agent_version = client.health().get('version')
+        except Exception:  # pylint: disable=broad-except
+            agent_version = None   # unreachable: bootstrap will restart
+        finally:
+            client.close()
+        if agent_version == skypilot_tpu.__version__:
+            return
+        logger.info(
+            f'Cluster {handle.cluster_name!r} agent runtime is '
+            f'{agent_version or "unreachable"}, client is '
+            f'{skypilot_tpu.__version__}; re-shipping runtime and '
+            f'restarting the agent.')
+        self._bootstrap_agent(handle)
+        # Persist the refreshed handle (new agent pid for local).
+        record = global_user_state.get_cluster(handle.cluster_name)
+        if record is not None:
+            global_user_state.add_or_update_cluster(
+                handle.cluster_name, handle, record['status'])
+        client = self._agent_client(handle)
+        try:
+            fresh = client.health().get('version')
+        finally:
+            client.close()
+        if fresh != skypilot_tpu.__version__:
+            raise exceptions.HeadNodeUnreachableError(
+                f'agent on {handle.cluster_name!r} still reports '
+                f'runtime {fresh!r} after re-shipping (client '
+                f'{skypilot_tpu.__version__}); down and relaunch')
 
     def _check_reusable(self, handle: ClusterHandle,
                         task: task_lib.Task) -> bool:
@@ -196,6 +238,26 @@ class TpuVmBackend(backend_lib.Backend):
         """Start the head-host agent (parity: start_skylet_on_head_node,
         instance_setup.py:490)."""
         if handle.cloud == 'local':
+            # A re-bootstrap (version drift) must not race the old agent
+            # for the port.
+            old_pid = handle.extras.get('agent_pid')
+            if old_pid:
+                try:
+                    os.kill(int(old_pid), signal.SIGTERM)
+                    # Wait it out: the new agent binds the same port,
+                    # and a draining old agent would both steal the bind
+                    # and answer health checks with the old version.
+                    deadline = time.time() + 10
+                    while time.time() < deadline:
+                        try:
+                            os.kill(int(old_pid), 0)
+                        except ProcessLookupError:
+                            break
+                        time.sleep(0.1)
+                    else:
+                        os.kill(int(old_pid), signal.SIGKILL)
+                except (ProcessLookupError, ValueError):
+                    pass
             env = dict(os.environ)
             env['SKYTPU_AGENT_HOME'] = self._agent_home(handle)
             # The agent child must import skypilot_tpu even when the parent

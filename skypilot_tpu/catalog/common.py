@@ -10,14 +10,38 @@ refresh them out-of-band without a package upgrade.
 """
 from __future__ import annotations
 
+import json
 import os
 import threading
-from typing import Callable, Optional
+import time
+from typing import Callable, Dict, Optional
 
 import pandas as pd
 
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
 CATALOG_SCHEMA_VERSION = 'v1'
 _BUNDLED_DIR = os.path.join(os.path.dirname(__file__), 'data')
+
+# Pricing data decays: after this many days a catalog is flagged stale
+# (warning on load + surfaced in `skytpu check`), prompting a
+# data_fetchers refresh.  The reference refreshes hosted CSVs on the
+# same staleness trigger (sky/catalog/common.py:165).
+try:
+    STALENESS_DAYS = float(
+        os.environ.get('SKYTPU_CATALOG_STALENESS_DAYS', '45'))
+except ValueError:
+    STALENESS_DAYS = 45.0   # malformed env must not break imports
+
+
+# Per-catalog refresh remediation (only files a fetcher actually
+# regenerates may point at that fetcher).
+_REFRESH_HINTS = {
+    'gcp_tpus.csv': ('python -m skypilot_tpu.catalog.data_fetchers'
+                     '.fetch_gcp'),
+}
 
 
 def catalog_override_dir() -> str:
@@ -33,6 +57,37 @@ def resolve_catalog_path(filename: str) -> str:
     if os.path.exists(override):
         return override
     return os.path.join(_BUNDLED_DIR, filename)
+
+
+def catalog_generated_at(filename: str) -> Optional[float]:
+    """Epoch seconds the catalog was generated, from the sidecar
+    `<filename>.meta.json` the fetchers write (bundled catalogs carry
+    one checked in at curation time).  None = unknown provenance."""
+    meta_path = resolve_catalog_path(filename) + '.meta.json'
+    if not os.path.exists(meta_path):
+        return None
+    try:
+        with open(meta_path, encoding='utf-8') as f:
+            return float(json.load(f)['generated_at'])
+    except (OSError, ValueError, KeyError, TypeError,
+            json.JSONDecodeError):
+        return None   # corrupt sidecar = unknown provenance, not a crash
+
+
+def write_catalog_metadata(path: str) -> None:
+    """Sidecar writer for data_fetchers: stamps `generated_at` now."""
+    with open(path + '.meta.json', 'w', encoding='utf-8') as f:
+        json.dump({'generated_at': time.time()}, f)
+
+
+def catalog_staleness(filename: str) -> Dict[str, object]:
+    """{'age_days': float|None, 'stale': bool} for `skytpu check`."""
+    generated = catalog_generated_at(filename)
+    if generated is None:
+        return {'age_days': None, 'stale': True}
+    age_days = max(0.0, (time.time() - generated) / 86400.0)
+    return {'age_days': round(age_days, 1),
+            'stale': age_days > STALENESS_DAYS}
 
 
 class LazyDataFrame:
@@ -57,6 +112,18 @@ class LazyDataFrame:
                     df = pd.read_csv(resolve_catalog_path(self._filename))
                     if self._postprocess is not None:
                         df = self._postprocess(df)
+                    staleness = catalog_staleness(self._filename)
+                    if staleness['stale']:
+                        age = staleness['age_days']
+                        hint = _REFRESH_HINTS.get(
+                            self._filename,
+                            f'place a refreshed CSV (+ .meta.json '
+                            f'sidecar) in {catalog_override_dir()}')
+                        logger.warning(
+                            f'catalog {self._filename} is '
+                            f'{"of unknown age" if age is None else f"{age} days old"}'
+                            f' (staleness threshold {STALENESS_DAYS:.0f}d); '
+                            f'prices may be wrong — refresh: {hint}')
                     self._df = df
         return df
 

@@ -98,6 +98,7 @@ def main() -> int:
             # without known zones are skipped rather than invented).
             for zone in known_zones.get((gen, region), []):
                 f.write(f'{gen},{region},{zone},{od},{sp}\n')
+    common.write_catalog_metadata(path)   # staleness provenance
     print(f'Wrote {path}')
     return 0
 

@@ -225,11 +225,17 @@ def accelerators(name_filter):
 
 @cli.command()
 def check():
-    """Check cloud credentials."""
+    """Check cloud credentials and catalog freshness."""
     for name, info in sdk.check().items():
         mark = 'enabled' if info['enabled'] else \
             f'disabled ({info["reason"]})'
         click.echo(f'  {name}: {mark}')
+    for fn, st in sdk.catalog_staleness().items():
+        age = st.get('age_days')
+        state = ('UNKNOWN AGE' if age is None else
+                 f'{age}d old' + (' — STALE, refresh with '
+                                  'data_fetchers' if st['stale'] else ''))
+        click.echo(f'  catalog {fn}: {state}')
 
 
 @cli.group()

@@ -306,3 +306,7 @@ def accelerators(name_filter: Optional[str] = None) -> Dict[str, Any]:
 
 def check() -> Dict[str, Any]:
     return _get('/check')
+
+
+def catalog_staleness() -> Dict[str, Any]:
+    return _get('/catalog/staleness')

@@ -87,7 +87,7 @@ async def _error_middleware(request, handler):
 @web.middleware
 async def _auth_middleware(request, handler):
     token = _auth_token()
-    if token and request.path != '/api/health':
+    if token and request.path not in ('/api/health', '/', '/dashboard'):
         header = request.headers.get('Authorization', '')
         supplied = header[7:] if header.startswith('Bearer ') else ''
         if not hmac.compare_digest(supplied, token):
@@ -184,6 +184,15 @@ def make_app() -> web.Application:
             'api_version': API_VERSION,
             'min_compatible_api_version': MIN_COMPATIBLE_API_VERSION,
         })
+
+    async def dashboard(request):
+        """Operator dashboard: a dependency-free page over this same
+        REST API (parity: sky/dashboard/).  The shell is auth-exempt
+        (it holds no data); every data fetch it makes carries the
+        bearer token the operator enters."""
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            'static', 'dashboard.html')
+        return web.FileResponse(path)
 
     async def drain(request):
         """Begin graceful shutdown: refuse new mutations, keep serving
@@ -594,6 +603,15 @@ def make_app() -> web.Application:
             out[name] = {'enabled': ok, 'reason': reason}
         return web.json_response(out)
 
+    async def catalog_staleness_route(request):
+        # Separate from /check so released clients iterating /check's
+        # entries as clouds keep working.
+        from skypilot_tpu.catalog import common as catalog_common
+        return web.json_response({
+            fn: catalog_common.catalog_staleness(fn)
+            for fn in ('gcp_tpus.csv', 'gcp_vms.csv')
+        })
+
     app.router.add_get('/api/health', health)
     app.router.add_get('/metrics', metrics_route)
     app.router.add_get('/requests/{request_id}', get_request)
@@ -624,7 +642,10 @@ def make_app() -> web.Application:
     app.router.add_get('/cost_report', cost_report)
     app.router.add_get('/accelerators', accelerators)
     app.router.add_get('/check', check)
+    app.router.add_get('/catalog/staleness', catalog_staleness_route)
     app.router.add_post('/api/drain', drain)
+    app.router.add_get('/dashboard', dashboard)
+    app.router.add_get('/', dashboard)
     return app
 
 

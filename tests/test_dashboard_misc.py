@@ -1,0 +1,112 @@
+"""Dashboard route, catalog staleness, runtime version pinning
+(parity: sky/dashboard/, sky/catalog/common.py staleness refresh,
+sky/backends/wheel_utils.py version pinning)."""
+import json
+import time
+
+import pytest
+import requests as requests_lib
+
+from tests.test_api_server import api_server, _mk_local_task  # noqa: F401
+
+
+# ----- dashboard -------------------------------------------------------------
+def test_dashboard_served(api_server):
+    for path in ('/', '/dashboard'):
+        resp = requests_lib.get(f'{api_server}{path}')
+        assert resp.status_code == 200
+        assert 'text/html' in resp.headers['Content-Type']
+        assert 'skytpu' in resp.text
+        # The page drives the same REST API the SDK uses.
+        for endpoint in ('/status', '/jobs/queue', '/serve/status',
+                         '/requests', '/volumes', '/api/health'):
+            assert endpoint in resp.text
+
+
+def test_dashboard_shell_exempt_from_auth(api_server, monkeypatch):
+    monkeypatch.setenv('SKYTPU_API_TOKEN', 'sekrit')
+    assert requests_lib.get(f'{api_server}/dashboard').status_code == 200
+    # ... but the data endpoints it calls still require the token.
+    assert requests_lib.get(f'{api_server}/status').status_code == 401
+
+
+# ----- catalog staleness -----------------------------------------------------
+def test_catalog_staleness(tmp_home, monkeypatch):
+    from skypilot_tpu.catalog import common as catalog_common
+    # Bundled catalogs carry curation-time metadata: fresh.
+    st = catalog_common.catalog_staleness('gcp_tpus.csv')
+    assert st['age_days'] is not None
+    # An old override catalog is flagged stale.
+    override = tmp_home / 'catalogs'
+    override.mkdir()
+    monkeypatch.setenv('SKYTPU_CATALOG_DIR', str(override))
+    (override / 'gcp_tpus.csv').write_text(
+        'generation,region,zone,price_chip_hr,spot_price_chip_hr\n')
+    (override / 'gcp_tpus.csv.meta.json').write_text(
+        json.dumps({'generated_at': time.time() - 90 * 86400}))
+    st = catalog_common.catalog_staleness('gcp_tpus.csv')
+    assert st['stale'] and st['age_days'] > 80
+    # Missing metadata = unknown provenance = stale.
+    (override / 'gcp_tpus.csv.meta.json').unlink()
+    st = catalog_common.catalog_staleness('gcp_tpus.csv')
+    assert st['stale'] and st['age_days'] is None
+
+
+def test_catalog_staleness_endpoint(api_server):
+    from skypilot_tpu.client import sdk
+    st = sdk.catalog_staleness()
+    assert 'gcp_tpus.csv' in st and 'stale' in st['gcp_tpus.csv']
+    # /check keeps its every-entry-is-a-cloud shape for old clients.
+    for info in sdk.check().values():
+        assert 'enabled' in info
+
+
+# ----- runtime version pinning -----------------------------------------------
+def test_agent_health_reports_version(tmp_home, enable_all_clouds):
+    import skypilot_tpu
+    from skypilot_tpu import execution
+    from skypilot_tpu.backends import TpuVmBackend
+    from skypilot_tpu import global_user_state
+    _, handle = execution.launch(_mk_local_task(), 'verc',
+                                 detach_run=True)
+    backend = TpuVmBackend()
+    client = backend._agent_client(handle)  # pylint: disable=protected-access
+    try:
+        assert client.health()['version'] == skypilot_tpu.__version__
+    finally:
+        client.close()
+
+
+def test_version_drift_triggers_reship(tmp_home, enable_all_clouds,
+                                       monkeypatch):
+    """A client whose version differs from the running agent re-ships;
+    since the re-shipped runtime still reports the REAL version (we
+    faked the client's), the persistent mismatch is a loud error, not a
+    silent job submission to an old agent."""
+    import skypilot_tpu
+    from skypilot_tpu import exceptions
+    from skypilot_tpu import execution
+    from skypilot_tpu.backends import TpuVmBackend
+    task = _mk_local_task()
+    _, handle = execution.launch(task, 'drift', detach_run=True)
+    old_pid = handle.extras.get('agent_pid')
+    monkeypatch.setattr(skypilot_tpu, '__version__', '99.0.0')
+    backend = TpuVmBackend()
+    with pytest.raises(exceptions.HeadNodeUnreachableError):
+        backend.provision(task, 'drift')
+    # The agent WAS restarted (re-ship happened).
+    from skypilot_tpu import global_user_state
+    new_pid = global_user_state.get_cluster(
+        'drift')['handle'].extras.get('agent_pid')
+    assert new_pid != old_pid
+
+
+def test_matching_version_reuses_without_restart(tmp_home,
+                                                 enable_all_clouds):
+    from skypilot_tpu import execution
+    from skypilot_tpu.backends import TpuVmBackend
+    task = _mk_local_task()
+    _, handle = execution.launch(task, 'same', detach_run=True)
+    pid = handle.extras.get('agent_pid')
+    out = TpuVmBackend().provision(task, 'same')
+    assert out.extras.get('agent_pid') == pid
