@@ -29,6 +29,7 @@ from skypilot_tpu.agent import client as agent_client_lib
 from skypilot_tpu.backends import backend as backend_lib
 from skypilot_tpu.global_user_state import ClusterHandle, ClusterStatus
 from skypilot_tpu.provision import failover
+from skypilot_tpu.optimizer import OptimizeTarget
 from skypilot_tpu.provision.common import ProvisionConfig
 from skypilot_tpu.utils import common_utils
 from skypilot_tpu.utils import command_runner as runner_lib
@@ -46,7 +47,8 @@ class TpuVmBackend(backend_lib.Backend):
     def provision(self, task: task_lib.Task, cluster_name: str,
                   dryrun: bool = False,
                   retry_until_up: bool = False,
-                  blocked_resources: Optional[list] = None
+                  blocked_resources: Optional[list] = None,
+                  minimize: Optional[OptimizeTarget] = None,
                   ) -> Optional[ClusterHandle]:
         if dryrun:
             return None
@@ -74,7 +76,8 @@ class TpuVmBackend(backend_lib.Backend):
                 return self._restart_locked(handle)
             return self._provision_locked(task, cluster_name,
                                           blocked_resources,
-                                          retry_until_up=retry_until_up)
+                                          retry_until_up=retry_until_up,
+                                          minimize=minimize)
 
     def _ensure_agent_version(self, handle: ClusterHandle) -> None:
         """Re-bootstrap the agent when its runtime version differs from
@@ -144,7 +147,8 @@ class TpuVmBackend(backend_lib.Backend):
     def _provision_locked(self, task: task_lib.Task,
                           cluster_name: str,
                           blocked_resources: Optional[list] = None,
-                          retry_until_up: bool = False
+                          retry_until_up: bool = False,
+                          minimize: Optional[OptimizeTarget] = None,
                           ) -> ClusterHandle:
         def provision_fn(candidate: resources_lib.Resources):
             authorized_key = None
@@ -191,7 +195,9 @@ class TpuVmBackend(backend_lib.Backend):
         result = failover.provision_with_retries(
             task, cluster_name, provision_fn, cleanup_fn=cleanup_fn,
             blocked_resources=blocked_resources,
-            retry_until_up=retry_until_up)
+            retry_until_up=retry_until_up,
+            minimize=(minimize if minimize is not None
+                      else failover.OptimizeTarget.COST))
         candidate = result.resources
         info = provision_lib.get_cluster_info(candidate.cloud, cluster_name,
                                               region=result.record.region,

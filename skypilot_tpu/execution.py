@@ -37,7 +37,7 @@ def launch(
     task: task_lib.Task,
     cluster_name: Optional[str] = None,
     *,
-    minimize: OptimizeTarget = OptimizeTarget.COST,
+    minimize: Optional[OptimizeTarget] = None,
     dryrun: bool = False,
     detach_run: bool = False,
     stages: Optional[List[Stage]] = None,
@@ -56,6 +56,13 @@ def launch(
     """
     cluster_name = cluster_name or f'sky-{common_utils.generate_id()}'
     common_utils.validate_cluster_name(cluster_name)
+    if minimize is None:
+        # No explicit objective: config default (optimizer.minimize),
+        # else cost.  An explicit argument always wins over config.
+        from skypilot_tpu import sky_config
+        configured = sky_config.get_nested(('optimizer', 'minimize'), None)
+        minimize = (OptimizeTarget(configured) if configured
+                    else OptimizeTarget.COST)
     # Org-wide admin policy hook (validate/mutate/reject); runs at this
     # chokepoint so CLI, SDK, managed jobs, and serve replicas are all
     # covered (including relaunches during jobs recovery — policies are
@@ -105,7 +112,7 @@ def _launch_staged(task, cluster_name, minimize, dryrun, detach_run,
         with timeline.Event('stage.provision'):
             handle = backend.provision(
                 task, cluster_name, blocked_resources=blocked_resources,
-                retry_until_up=retry_until_up)
+                retry_until_up=retry_until_up, minimize=minimize)
     else:
         record = global_user_state.get_cluster(cluster_name)
         if record is None:

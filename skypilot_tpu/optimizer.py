@@ -34,6 +34,45 @@ _DEFAULT_RUNTIME_S = 3600.0  # assumed run time when the task gives none
 class OptimizeTarget(enum.Enum):
     COST = 'cost'
     TIME = 'time'
+    # $/effective-FLOP: hourly cost divided by delivered bf16 compute
+    # (aggregate peak x assumed MFU).  For a fixed training workload
+    # this ranks placements exactly like $/1M-tokens — the
+    # model-dependent tokens/FLOP factor is a constant across
+    # candidates — so it is the cost-per-token objective without
+    # needing the model size (SURVEY §7's north-star metric).
+    COST_PER_FLOP = 'cost_per_flop'
+
+
+# Fraction of peak the optimizer assumes a tuned workload achieves; the
+# bench's measured MFU (bench.py) is the source for this default.
+ASSUMED_MFU = 0.45
+
+
+def effective_tflops(candidate: 'resources_lib.Resources',
+                     num_nodes: int = 1) -> Optional[float]:
+    """Delivered bf16 TFLOP/s of a placement (peak x assumed MFU), or
+    None for non-TPU candidates."""
+    tpu = candidate.tpu
+    if tpu is None:
+        return None
+    # TpuType.bf16_tflops is the slice AGGREGATE (per-chip x chips).
+    return tpu.bf16_tflops * ASSUMED_MFU * num_nodes
+
+
+def cost_per_million_tokens(candidate: 'resources_lib.Resources',
+                            hourly_cost: float,
+                            params_billion: float,
+                            num_nodes: int = 1,
+                            mfu: float = ASSUMED_MFU) -> Optional[float]:
+    """Training $/1M tokens for a dense model of `params_billion`
+    parameters at `mfu` (6·N FLOPs/token), on this placement (public
+    what-if helper for planning; bench.py reports the measured analog)."""
+    tpu = candidate.tpu
+    if tpu is None or params_billion <= 0:
+        return None
+    flops_per_s = tpu.bf16_tflops * 1e12 * mfu * num_nodes
+    tokens_per_s = flops_per_s / (6.0 * params_billion * 1e9)
+    return hourly_cost / 3600.0 / tokens_per_s * 1e6
 
 
 def _blocked(candidate: resources_lib.Resources,
@@ -172,8 +211,9 @@ class Optimizer:
     def _candidates_with_metrics(
         cls, task: task_lib.Task,
         blocked_resources: Optional[List[resources_lib.Resources]],
-    ) -> List[Tuple[resources_lib.Resources, float, float]]:
-        """[(candidate, cost_$, time_s)] for all feasible placements."""
+    ) -> List[Tuple[resources_lib.Resources, float, float, float]]:
+        """[(candidate, cost_$, time_s, hourly_$)] for all feasible
+        placements."""
         memo: dict = {}
         per_request = fill_in_launchable_resources(task, blocked_resources,
                                                    cost_memo=memo)
@@ -183,7 +223,7 @@ class Optimizer:
             for c in candidates:
                 time_s = _estimate_runtime_s(task, c)
                 cost = hourly_of(c) * task.num_nodes * time_s / 3600.0
-                out.append((c, cost, time_s))
+                out.append((c, cost, time_s, hourly_of(c)))
         if not out:
             raise exceptions.ResourcesUnavailableError(
                 f'No launchable resources satisfy task {task.name!r}: '
@@ -191,6 +231,25 @@ class Optimizer:
                 + (f' (blocked: {len(blocked_resources)})'
                    if blocked_resources else ''))
         return out
+
+    @staticmethod
+    def _objective(minimize: OptimizeTarget, task: task_lib.Task,
+                   cand: resources_lib.Resources, cost: float,
+                   time_s: float, hourly: float) -> float:
+        if minimize is OptimizeTarget.TIME:
+            return time_s
+        if minimize is OptimizeTarget.COST_PER_FLOP:
+            eff = effective_tflops(cand, task.num_nodes)
+            if eff is not None:
+                return hourly * task.num_nodes / eff
+            if any(r.is_tpu for r in task.resources):
+                # Mixed TPU/CPU candidate sets must not compare
+                # incomparable units: a CPU placement delivers no
+                # training FLOPs, so it can never win this objective.
+                return float('inf')
+            # Pure non-TPU task: $ decides.
+            return cost
+        return cost
 
     # ----- chain DP ----------------------------------------------------------
     @classmethod
@@ -203,29 +262,30 @@ class Optimizer:
         tasks = dag.topological_order()
         if not tasks:
             return
-        all_cands: List[List[Tuple[resources_lib.Resources, float, float]]] = [
+        all_cands = [
             cls._candidates_with_metrics(t, blocked_resources) for t in tasks
         ]
         # dp[i][j] = (best objective to schedule tasks[:i+1] with tasks[i] on
         # candidate j, parent index)
         dp: List[List[Tuple[float, int]]] = []
         first = []
-        for cand, cost, time_s in all_cands[0]:
-            obj = cost if minimize is OptimizeTarget.COST else time_s
-            first.append((obj, -1))
+        for cand, cost, time_s, hourly in all_cands[0]:
+            first.append((cls._objective(minimize, tasks[0], cand, cost,
+                                         time_s, hourly), -1))
         dp.append(first)
         for i in range(1, len(tasks)):
             out_gb = getattr(tasks[i - 1], 'estimated_output_gb', None) or 0.0
             row = []
-            for cand, cost, time_s in all_cands[i]:
+            for cand, cost, time_s, hourly in all_cands[i]:
+                node_obj = cls._objective(minimize, tasks[i], cand, cost,
+                                          time_s, hourly)
                 best = (float('inf'), -1)
                 for j, (prev_obj, _) in enumerate(dp[i - 1]):
                     prev_cand = all_cands[i - 1][j][0]
                     egress = _egress_cost(prev_cand, cand, out_gb)
-                    if minimize is OptimizeTarget.COST:
-                        obj = prev_obj + cost + egress
-                    else:
-                        obj = prev_obj + time_s
+                    # Egress is $; it only composes with the $ objective.
+                    obj = prev_obj + node_obj + (
+                        egress if minimize is OptimizeTarget.COST else 0.0)
                     if obj < best[0]:
                         best = (obj, j)
                 row.append(best)
@@ -233,8 +293,7 @@ class Optimizer:
         # Backtrack.
         last = min(range(len(dp[-1])), key=lambda j: dp[-1][j][0])
         for i in range(len(tasks) - 1, -1, -1):
-            cand, cost, time_s = all_cands[i][last]
-            tasks[i].best_resources = cand
+            tasks[i].best_resources = all_cands[i][last][0]
             last = dp[i][last][1]
 
     @classmethod
@@ -246,9 +305,10 @@ class Optimizer:
         egress globally; without pulp, per-task optimal ignoring edges)."""
         for task in dag.topological_order():
             cands = cls._candidates_with_metrics(task, blocked_resources)
-            key = (lambda x: x[1]) if minimize is OptimizeTarget.COST else (
-                lambda x: x[2])
-            task.best_resources = min(cands, key=key)[0]
+            task.best_resources = min(
+                cands,
+                key=lambda x: cls._objective(minimize, task, x[0], x[1],
+                                             x[2], x[3]))[0]
 
     # ----- reporting ---------------------------------------------------------
     @classmethod
@@ -266,16 +326,20 @@ class Optimizer:
             total_cost += cost
             tpu = best.tpu
             chips = tpu.num_chips if tpu else '-'
+            eff = effective_tflops(best, t.num_nodes)
+            eff_col = (f'${hourly * t.num_nodes / (eff / 1000):.2f}'
+                       if eff else '-')
             rows.append([
                 t.name or '-', str(best.infra),
                 best.accelerator_name or best.instance_type or 'cpu',
                 str(chips), f'{t.num_nodes}',
                 f'${hourly * t.num_nodes:.2f}',
+                eff_col,
                 common_utils.readable_time_duration(time_s),
                 f'${cost:.2f}',
             ])
         header = ['TASK', 'INFRA', 'ACCELERATOR', 'CHIPS', 'NODES',
-                  '$/HR', 'EST.TIME', 'EST.COST']
+                  '$/HR', '$/EFF-PFLOPS-HR', 'EST.TIME', 'EST.COST']
         title = (f'Optimizer target: {minimize.value}  '
                  f'(plan total: ${total_cost:.2f})')
         ux_utils.print_table(header, rows, title=title)

@@ -66,6 +66,7 @@ def provision_with_retries(
     cleanup_fn: Optional[Callable[[resources_lib.Resources], None]] = None,
     retry_until_up: bool = False,
     max_rounds: Optional[int] = None,
+    minimize: OptimizeTarget = OptimizeTarget.COST,
 ) -> ProvisionAttemptResult:
     """Try placements until one provisions.
 
@@ -93,7 +94,7 @@ def provision_with_retries(
         for attempt in range(max_attempts):
             single = dag_lib.dag_from_task(task)
             try:
-                Optimizer.optimize(single, minimize=OptimizeTarget.COST,
+                Optimizer.optimize(single, minimize=minimize,
                                    blocked_resources=blocked, quiet=True)
             except exceptions.ResourcesUnavailableError as e:
                 exhausted = e

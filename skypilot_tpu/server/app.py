@@ -17,7 +17,6 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
-import hmac
 import json
 import os
 from typing import Any, Dict, Optional
@@ -86,12 +85,18 @@ async def _error_middleware(request, handler):
 
 @web.middleware
 async def _auth_middleware(request, handler):
-    token = _auth_token()
-    if token and request.path not in ('/api/health', '/', '/dashboard'):
+    from skypilot_tpu.utils import auth
+    auth_on = _auth_token() or auth.get_token_users()
+    if auth_on and request.path not in ('/api/health', '/', '/dashboard'):
         header = request.headers.get('Authorization', '')
         supplied = header[7:] if header.startswith('Bearer ') else ''
-        if not hmac.compare_digest(supplied, token):
+        ok, user = auth.authenticate(supplied)
+        if not ok:
             return web.json_response({'error': 'unauthorized'}, status=401)
+        if user is not None:
+            # Per-user token: the bearer IS the identity — it beats any
+            # X-SkyTPU-User header the caller also sent.
+            request['auth_user'] = user
     return await handler(request)
 
 
@@ -231,7 +236,7 @@ def make_app() -> web.Application:
         headers, forwarded by the SDK); header-less requests keep the
         server's ambient identity.  Used for work running on executor
         threads, where the route's own context does not follow."""
-        user = request.headers.get(USER_HEADER)
+        user = request.get('auth_user') or request.headers.get(USER_HEADER)
         workspace = request.headers.get(WORKSPACE_HEADER)
 
         def wrapped(*args, **kwargs):
@@ -245,7 +250,7 @@ def make_app() -> web.Application:
     def _inject_identity(request, body):
         """Worker processes re-create identity from the payload (they
         are fresh spawns; thread-local overrides cannot reach them)."""
-        user = request.headers.get(USER_HEADER)
+        user = request.get('auth_user') or request.headers.get(USER_HEADER)
         workspace = request.headers.get(WORKSPACE_HEADER)
         if user:
             body['_user'] = user

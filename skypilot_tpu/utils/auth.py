@@ -1,11 +1,21 @@
 """Shared client/server auth-token lookup: env SKYTPU_API_TOKEN, then
 api_server.auth_token in the layered config.  One helper so the server
 middleware and both SDKs can never drift on where the token comes from.
+
+Two server-side modes (parity: the reference's service-account tokens,
+sky/users/token_service.py):
+
+- shared token (``api_server.auth_token``): one bearer gates the API,
+  identity comes from the X-SkyTPU-User header (trusted channel);
+- per-user tokens (``api_server.tokens: {token: username}``): the
+  bearer IS the identity — the header is ignored for authenticated
+  users, so identity can no longer be spoofed by other token holders.
 """
 from __future__ import annotations
 
+import hmac
 import os
-from typing import Optional
+from typing import Dict, Optional, Tuple
 
 
 def get_auth_token() -> Optional[str]:
@@ -14,3 +24,38 @@ def get_auth_token() -> Optional[str]:
         return token
     from skypilot_tpu import sky_config
     return sky_config.get_nested(('api_server', 'auth_token'), None)
+
+
+def get_token_users() -> Dict[str, str]:
+    """Per-user service tokens from config: {token: username}."""
+    from skypilot_tpu import sky_config
+    tokens = sky_config.get_nested(('api_server', 'tokens'), None)
+    if not tokens:
+        return {}
+    return {str(k): str(v) for k, v in tokens.items()}
+
+
+def _tokens_equal(a: str, b: str) -> bool:
+    # Bytes, not str: compare_digest raises TypeError on non-ASCII
+    # strings, and the supplied token is attacker-controlled.
+    return hmac.compare_digest(a.encode('utf-8', 'surrogateescape'),
+                               b.encode('utf-8', 'surrogateescape'))
+
+
+def authenticate(supplied: str) -> Tuple[bool, Optional[str]]:
+    """(authorized, authenticated_user) for a supplied bearer token.
+
+    Per-user tokens bind identity; the shared token authorizes without
+    binding (identity then comes from the user header).  With neither
+    configured the API is open: (True, None).
+    """
+    token_users = get_token_users()
+    for token, user in token_users.items():
+        if _tokens_equal(supplied, token):
+            return True, user
+    shared = get_auth_token()
+    if shared:
+        return _tokens_equal(supplied, shared), None
+    # No auth configured: open (single-user/dev), unless per-user
+    # tokens exist — then only they grant access.
+    return (False, None) if token_users else (True, None)

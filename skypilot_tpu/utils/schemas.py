@@ -136,6 +136,11 @@ CONFIG_SCHEMA: Dict[str, Any] = {
                 'endpoint': {'type': 'string'},
                 'workers': {'type': 'integer'},
                 'auth_token': {'type': 'string'},
+                # Per-user service tokens: {token: username}.
+                'tokens': {
+                    'type': 'object',
+                    'additionalProperties': {'type': 'string'},
+                },
             },
         },
         'gcp': {
@@ -163,7 +168,7 @@ CONFIG_SCHEMA: Dict[str, Any] = {
             'type': 'object',
             'additionalProperties': False,
             'properties': {
-                'minimize': {'enum': ['cost', 'time']},
+                'minimize': {'enum': ['cost', 'time', 'cost_per_flop']},
             },
         },
         'logs': {'type': 'object'},

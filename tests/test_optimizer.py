@@ -103,6 +103,67 @@ def test_optimize_chain_dag(enable_all_clouds):
         assert t.best_resources is not None and t.best_resources.is_launchable()
 
 
+def test_optimize_cost_per_flop_prefers_efficient_silicon(
+        enable_all_clouds):
+    """$/effective-FLOP ranks by delivered-compute dollars, not sticker
+    price: across generations it must pick the placement with the best
+    hourly/(chips x TFLOPs) ratio — the $/1M-tokens objective."""
+    from skypilot_tpu.optimizer import effective_tflops
+    t = _mk_task('train')
+    t.set_resources({
+        Resources.from_yaml_config({'accelerators': 'tpu-v5litepod-8'}),
+        Resources.from_yaml_config({'accelerators': 'tpu-v6e-8'}),
+        Resources.from_yaml_config({'accelerators': 'tpu-v5p-8'}),
+    })
+    Optimizer.optimize(_dag_of(t), minimize=OptimizeTarget.COST_PER_FLOP,
+                       quiet=True)
+    best = t.best_resources
+    # Verify optimality against the exhaustive candidate set.
+    best_ratio = None
+    for cands in fill_in_launchable_resources(t).values():
+        for c in cands:
+            hourly = clouds.get_cloud(c.cloud).hourly_cost(c)
+            ratio = hourly / effective_tflops(c)
+            if best_ratio is None or ratio < best_ratio[0]:
+                best_ratio = (ratio, c)
+    chosen_hourly = clouds.get_cloud(best.cloud).hourly_cost(best)
+    assert chosen_hourly / effective_tflops(best) == \
+        pytest.approx(best_ratio[0])
+
+
+def test_cost_per_million_tokens_math():
+    from skypilot_tpu.optimizer import (ASSUMED_MFU,
+                                        cost_per_million_tokens)
+    res = Resources.from_yaml_config(
+        {'accelerators': 'tpu-v6e-8', 'infra': 'gcp/us-central1'})
+    # 8 chips x 918 TFLOPs x MFU; 1B params => 6e9 FLOPs/token.
+    got = cost_per_million_tokens(res, hourly_cost=10.0,
+                                  params_billion=1.0)
+    tokens_per_s = (8 * 918e12 * ASSUMED_MFU) / 6e9
+    want = 10.0 / 3600.0 / tokens_per_s * 1e6
+    assert got == pytest.approx(want)
+    assert cost_per_million_tokens(
+        Resources.from_yaml_config({'cpus': '4'}), 1.0, 1.0) is None
+
+
+def test_config_sets_default_objective(enable_all_clouds, tmp_home,
+                                       monkeypatch):
+    (tmp_home / '.skytpu.yaml').write_text(
+        'optimizer:\n  minimize: cost_per_flop\n')
+    calls = {}
+    from skypilot_tpu import execution
+    real = Optimizer.optimize
+
+    def spy(dag, minimize=OptimizeTarget.COST, **kw):
+        calls['minimize'] = minimize
+        return real(dag, minimize=minimize, **kw)
+
+    monkeypatch.setattr(Optimizer, 'optimize', spy)
+    t = _mk_task('c', infra='local')
+    execution.launch(t, 'cfgmin', dryrun=True)
+    assert calls['minimize'] is OptimizeTarget.COST_PER_FLOP
+
+
 def test_optimize_spot(enable_all_clouds):
     t = _mk_task('train', acc='tpu-v5p-8', infra='gcp', use_spot=True)
     Optimizer.optimize(_dag_of(t), quiet=True)

@@ -172,6 +172,47 @@ def test_workspace_header_over_rest(api_server, tmp_home):
     assert in_b == []
 
 
+# ----- per-user service tokens -----------------------------------------------
+def test_per_user_tokens_bind_identity(api_server, tmp_home):
+    """With api_server.tokens, the bearer IS the identity: a spoofed
+    X-SkyTPU-User header is ignored (parity: service-account tokens,
+    sky/users/token_service.py)."""
+    _write_cfg(tmp_home,
+               'api_server:\n  tokens:\n    tok-alice: alice\n'
+               '    tok-bob: bob\n')
+    # No token -> 401 (per-user tokens imply auth is on).
+    assert requests_lib.get(f'{api_server}/status').status_code == 401
+    assert requests_lib.get(
+        f'{api_server}/status',
+        headers={'Authorization': 'Bearer wrong'}).status_code == 401
+    # Launch with alice's token while claiming to be bob in the header:
+    # the cluster is alice's.
+    body = {'task': _mk_local_task().to_yaml_config(),
+            'cluster_name': 'tokc'}
+    resp = requests_lib.post(
+        f'{api_server}/launch', json=body,
+        headers={'Authorization': 'Bearer tok-alice',
+                 USER_HEADER: 'bob'})
+    assert resp.status_code == 200
+    import time as time_lib
+    deadline = time_lib.time() + 60
+    while time_lib.time() < deadline:
+        rec = global_user_state.get_cluster('tokc')
+        if rec is not None:
+            break
+        time_lib.sleep(0.3)
+    assert rec is not None and rec['user_name'] == 'alice'
+    # bob's token sees nothing by default; alice's sees her cluster.
+    as_bob = requests_lib.get(
+        f'{api_server}/status',
+        headers={'Authorization': 'Bearer tok-bob'}).json()
+    as_alice = requests_lib.get(
+        f'{api_server}/status',
+        headers={'Authorization': 'Bearer tok-alice'}).json()
+    assert as_bob == []
+    assert [r['name'] for r in as_alice] == ['tokc']
+
+
 # ----- managed jobs tagging --------------------------------------------------
 def test_jobs_tagged_and_filtered(tmp_home, enable_all_clouds,
                                   monkeypatch):
