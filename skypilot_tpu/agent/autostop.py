@@ -14,8 +14,6 @@ the agent just executes what was configured.
 """
 from __future__ import annotations
 
-import os
-import threading
 import time
 from typing import Optional
 
@@ -122,25 +120,6 @@ def maybe_enforce(identity: ClusterIdentity, started_at: float) -> bool:
     return True
 
 
-class AutostopEvent(threading.Thread):
-    """Periodic enforcement loop (reference ticks every 60s,
-    events.py:161; interval overridable for tests)."""
-
-    def __init__(self, identity: ClusterIdentity, started_at: float) -> None:
-        super().__init__(name='autostop-event', daemon=True)
-        self.identity = identity
-        self.started_at = started_at
-        self.interval = float(
-            os.environ.get('SKYTPU_AGENT_EVENT_INTERVAL', '20'))
-        self._stop = threading.Event()
-
-    def stop(self) -> None:
-        self._stop.set()
-
-    def run(self) -> None:
-        while not self._stop.is_set():
-            try:
-                maybe_enforce(self.identity, self.started_at)
-            except Exception as e:  # pylint: disable=broad-except
-                logger.warning(f'autostop event error: {e}')
-            self._stop.wait(self.interval)
+# The periodic loop lives in agent/events.py (EventLoop): autostop is
+# one event on the agent's shared ticker, alongside log GC — the same
+# roster shape as the reference skylet's EVENTS list.

@@ -1,0 +1,80 @@
+"""Agent-side periodic events (parity: sky/skylet/events.py:30
+SkyletEvent — the skylet runs a roster of periodic events; here the
+head agent runs the same pattern).
+
+Each event is a named periodic check on one shared ticker thread with
+per-tick error isolation.  Current roster:
+
+- autostop enforcement (agent/autostop.py maybe_enforce);
+- job-log GC: prune log directories of long-finished jobs so a
+  months-lived cluster's disk doesn't fill with per-rank logs
+  (shipped copies live in the external sink — logs/ — when
+  configured).
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+import time
+from typing import Callable, List, Tuple
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.agent import autostop as autostop_lib
+from skypilot_tpu.agent import job_queue
+
+logger = sky_logging.init_logger(__name__)
+
+
+def _log_retention_s() -> float:
+    return float(os.environ.get('SKYTPU_AGENT_LOG_RETENTION_HOURS',
+                                '168')) * 3600.0
+
+
+def gc_job_logs() -> int:
+    """Delete log dirs of jobs that finished more than the retention
+    window ago; returns how many were pruned."""
+    cutoff = time.time() - _log_retention_s()
+    pruned = 0
+    # Unbounded scan: the default list window (newest 100) would let an
+    # old job's logs escape GC forever on a busy cluster.
+    for job in job_queue.list_jobs(limit=1 << 30):
+        ended = job.get('ended_at')
+        if not ended or ended > cutoff:
+            continue
+        log_dir = job_queue.log_dir(job['job_id'])
+        if os.path.isdir(log_dir):
+            shutil.rmtree(log_dir, ignore_errors=True)
+            pruned += 1
+    if pruned:
+        logger.info(f'log-gc: pruned {pruned} finished-job log dirs')
+    return pruned
+
+
+class EventLoop(threading.Thread):
+    """One ticker running the agent's event roster (reference: the
+    skylet main loop iterating EVENTS, sky/skylet/skylet.py)."""
+
+    def __init__(self, identity: autostop_lib.ClusterIdentity,
+                 started_at: float) -> None:
+        super().__init__(name='agent-events', daemon=True)
+        self.interval = float(
+            os.environ.get('SKYTPU_AGENT_EVENT_INTERVAL', '20'))
+        self._stop = threading.Event()
+        self.events: List[Tuple[str, Callable[[], object]]] = [
+            ('autostop',
+             lambda: autostop_lib.maybe_enforce(identity, started_at)),
+            ('log-gc', gc_job_logs),
+        ]
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def run(self) -> None:
+        while not self._stop.is_set():
+            for name, fn in self.events:
+                try:
+                    fn()
+                except Exception as e:  # pylint: disable=broad-except
+                    logger.warning(f'agent event {name!r} failed: {e}')
+            self._stop.wait(self.interval)

@@ -115,12 +115,13 @@ def make_app(scheduler: Optional[AgentScheduler] = None,
     started_at = time.time()
     identity = identity or autostop_lib.ClusterIdentity(
         None, None, None, None)
-    event = autostop_lib.AutostopEvent(identity, started_at)
-    event.start()
-    app['autostop_event'] = event
+    from skypilot_tpu.agent import events as events_lib
+    event_loop = events_lib.EventLoop(identity, started_at)
+    event_loop.start()
+    app['events'] = event_loop
 
     async def _stop_event(_app):
-        event.stop()
+        event_loop.stop()
         sched.stop()
 
     app.on_cleanup.append(_stop_event)

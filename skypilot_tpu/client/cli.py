@@ -229,6 +229,11 @@ def check():
     for name, info in sdk.check().items():
         mark = 'enabled' if info['enabled'] else \
             f'disabled ({info["reason"]})'
+        storage = info.get('storage')
+        if storage is not None and storage['enabled'] != info['enabled']:
+            smark = 'enabled' if storage['enabled'] else \
+                f'disabled ({storage["reason"]})'
+            mark += f'  [storage: {smark}]'
         click.echo(f'  {name}: {mark}')
     for fn, st in sdk.catalog_staleness().items():
         age = st.get('age_days')

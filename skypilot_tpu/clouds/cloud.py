@@ -94,5 +94,16 @@ class Cloud:
         """(ok, reason) — `sky check` analog."""
         return True, None
 
+    def check_storage_credentials(self, compute_result=None) -> tuple:
+        """(ok, reason) for the cloud's STORAGE capability specifically
+        (parity: sky/check.py:81's compute-vs-storage capability split:
+        a principal can often read/write buckets without compute
+        permissions, or vice versa).  Default: same as compute.
+        `compute_result` lets callers that already ran
+        check_credentials avoid re-probing (credential probes shell
+        out)."""
+        return (compute_result if compute_result is not None
+                else self.check_credentials())
+
     def __repr__(self) -> str:
         return self.NAME

@@ -84,3 +84,27 @@ class GCP(cloud_lib.Cloud):
         return False, ('No GCP credentials found. Run `gcloud auth '
                        'application-default login` or set '
                        'GOOGLE_APPLICATION_CREDENTIALS.')
+
+    def check_storage_credentials(self, compute_result=None) -> tuple:
+        """GCS access is a separate surface: gsutil/ADC can work while
+        compute APIs are unauthorized and vice versa (the reference
+        records the two capabilities independently, sky/check.py:81)."""
+        fake = os.environ.get('SKYTPU_FAKE_GCS_ROOT')
+        if fake:
+            return True, None   # hermetic test stores
+        try:
+            proc = subprocess.run(['gsutil', 'version'],
+                                  capture_output=True, text=True,
+                                  timeout=10, check=False)
+        except FileNotFoundError:
+            return False, ('gsutil not found; GCS storage mounts and '
+                           'bucket lifecycle need the Cloud SDK.')
+        except subprocess.TimeoutExpired:
+            return False, 'gsutil probe timed out'
+        if proc.returncode != 0:
+            return False, (f'gsutil is installed but failing: '
+                           f'{(proc.stderr or proc.stdout).strip()[:200]}')
+        ok, reason = (compute_result if compute_result is not None
+                      else self.check_credentials())
+        return ok, (None if ok else
+                    f'gsutil present but no credentials: {reason}')

@@ -602,10 +602,22 @@ def make_app() -> web.Application:
 
     async def check(request):
         from skypilot_tpu import clouds as clouds_lib
-        out = {}
-        for name, cloud in clouds_lib.CLOUD_REGISTRY.items():
-            ok, reason = cloud.check_credentials()
-            out[name] = {'enabled': ok, 'reason': reason}
+
+        def run_checks():
+            out = {}
+            for name, cloud in clouds_lib.CLOUD_REGISTRY.items():
+                ok, reason = cloud.check_credentials()
+                s_ok, s_reason = cloud.check_storage_credentials(
+                    compute_result=(ok, reason))
+                # Compute and storage are separate capabilities
+                # (sky/check.py:81): either can work without the other.
+                out[name] = {'enabled': ok, 'reason': reason,
+                             'storage': {'enabled': s_ok,
+                                         'reason': s_reason}}
+            return out
+
+        out = await asyncio.get_event_loop().run_in_executor(None,
+                                                             run_checks)
         return web.json_response(out)
 
     async def catalog_staleness_route(request):
