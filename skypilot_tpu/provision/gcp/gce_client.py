@@ -107,7 +107,8 @@ class GceClient:
                        labels: Optional[Dict[str, str]],
                        metadata: Optional[Dict[str, str]],
                        disk_size_gb: int,
-                       attach_disks: Optional[List[str]] = None
+                       attach_disks: Optional[List[str]] = None,
+                       source_image: Optional[str] = None
                        ) -> Dict[str, Any]:
         body: Dict[str, Any] = {
             'name': name,
@@ -116,7 +117,7 @@ class GceClient:
                 'boot': True,
                 'autoDelete': True,
                 'initializeParams': {
-                    'sourceImage': _DEFAULT_IMAGE,
+                    'sourceImage': source_image or _DEFAULT_IMAGE,
                     'diskSizeGb': str(disk_size_gb),
                 },
             }] + [{
@@ -150,9 +151,11 @@ class GceClient:
                         labels: Optional[Dict[str, str]] = None,
                         metadata: Optional[Dict[str, str]] = None,
                         disk_size_gb: int = 100,
-                        attach_disks: Optional[List[str]] = None) -> None:
+                        attach_disks: Optional[List[str]] = None,
+                        source_image: Optional[str] = None) -> None:
         body = self._instance_body(zone, name, machine_type, spot, labels,
-                                   metadata, disk_size_gb, attach_disks)
+                                   metadata, disk_size_gb, attach_disks,
+                                   source_image)
         op = self._request('POST', f'{self._zone_path(zone)}/instances',
                            body=body)
         self.wait_zone_operation(zone, op)
@@ -161,12 +164,14 @@ class GceClient:
                               machine_type: str, spot: bool = False,
                               labels: Optional[Dict[str, str]] = None,
                               metadata: Optional[Dict[str, str]] = None,
-                              disk_size_gb: int = 100) -> None:
+                              disk_size_gb: int = 100,
+                              source_image: Optional[str] = None) -> None:
         """One bulkInsert call for N homogeneous VMs (reference:
         instance_utils.py:788) — atomic-ish gang creation for multi-node
         CPU clusters."""
         props = self._instance_body(zone, '', machine_type, spot, labels,
-                                    metadata, disk_size_gb)
+                                    metadata, disk_size_gb,
+                                    source_image=source_image)
         props.pop('name')
         body = {
             'count': str(len(names)),

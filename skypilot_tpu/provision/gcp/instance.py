@@ -98,6 +98,11 @@ def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
         raise exceptions.InvalidRequestError(
             'gcp-disk volumes cannot attach to TPU slices; use storage '
             '(bucket) mounts for checkpoints/datasets on TPUs')
+    if res.image_id:
+        raise exceptions.InvalidRequestError(
+            'image_id does not apply to TPU slices; their software '
+            'stack is selected by the TPU runtime version (the '
+            '`runtime_version` resources field)')
     client = _client()
     zone = config.zone
     existing = _cluster_nodes(client, zone, config.cluster_name)
@@ -183,6 +188,8 @@ def _run_gce_instances(config: common.ProvisionConfig,
     if config.authorized_key:
         metadata['ssh-keys'] = f'skytpu:{config.authorized_key}'
     attach_disks = sorted(config.volumes.values()) or None
+    source_image = res.image_id
+    disk_size_gb = int(res.disk_size)
     if attach_disks:
         # Format-if-new and mount each named disk at its mount_path on
         # boot (the k8s path gets this from the kubelet; VMs need it
@@ -245,7 +252,9 @@ def _run_gce_instances(config: common.ProvisionConfig,
         client.create_instance(zone, to_create[0], machine_type,
                                spot=res.use_spot, labels=labels,
                                metadata=metadata,
-                               attach_disks=attach_disks)
+                               disk_size_gb=disk_size_gb,
+                               attach_disks=attach_disks,
+                               source_image=source_image)
     elif to_create:
         if attach_disks:
             # A zonal persistent disk attaches to one VM (ReadWriteOnce);
@@ -255,7 +264,9 @@ def _run_gce_instances(config: common.ProvisionConfig,
                 'use storage (bucket) mounts for multi-node tasks')
         client.bulk_create_instances(zone, to_create, machine_type,
                                      spot=res.use_spot, labels=labels,
-                                     metadata=metadata)
+                                     metadata=metadata,
+                                     disk_size_gb=disk_size_gb,
+                                     source_image=source_image)
     return common.ProvisionRecord('gcp', config.cluster_name,
                                   config.region, zone, instance_ids,
                                   resumed=resumed)

@@ -167,8 +167,11 @@ def _pod_spec(config: common.ProvisionConfig, index: int, node: int,
               **config.labels}
     container: dict = {
         'name': 'skytpu',
-        'image': os.environ.get('SKYTPU_K8S_IMAGE',
-                                'python:3.11-slim'),
+        # Task-pinned container image wins; env default otherwise
+        # (resources.image_id — the docker-image story on this
+        # substrate).
+        'image': res.image_id or os.environ.get('SKYTPU_K8S_IMAGE',
+                                                'python:3.11-slim'),
         # The runtime bootstrap (agent start) arrives via command_runner
         # after provisioning, mirroring the VM path; the pod just stays
         # up.

@@ -336,6 +336,11 @@ class Resources:
                     return False
         if self.use_spot and not other.use_spot:
             return False
+        if self.image_id is not None and self.image_id != other.image_id:
+            # A reused cluster boots the image it was created with; a
+            # request pinning a different image must not be silently
+            # served by the old one.
+            return False
         return other._cpu_mem_at_least(self)  # pylint: disable=protected-access
 
     def get_cost(self, seconds: float) -> float:

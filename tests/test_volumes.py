@@ -172,6 +172,39 @@ def test_zone_mismatch_rejected(tmp_home, fake_gce):
                 {'infra': 'gcp/us-central1/us-central1-b'}))
 
 
+def test_image_id_plumbs_to_substrates(tmp_home, fake_k8s, fake_gce):
+    """resources.image_id reaches the pod image and the GCE boot disk;
+    TPU slices reject it loudly (their stack is the runtime version)."""
+    from skypilot_tpu import provision
+    cfg = ProvisionConfig(
+        cluster_name='img', num_nodes=1,
+        resources_config={'cpus': '2', 'infra': 'kubernetes/main',
+                          'image_id': 'ghcr.io/acme/trainer:v7'},
+        region='main')
+    provision.run_instances('kubernetes', cfg)
+    pod = fake_k8s.pod('default', 'img-0')
+    assert pod['spec']['containers'][0]['image'] == \
+        'ghcr.io/acme/trainer:v7'
+    gcfg = ProvisionConfig(
+        cluster_name='imgv', num_nodes=1,
+        resources_config={'cpus': '4',
+                          'infra': 'gcp/us-central1/us-central1-a',
+                          'image_id': 'projects/acme/global/images/base'},
+        region='us-central1', zone='us-central1-a')
+    provision.run_instances('gcp', gcfg)
+    inst = fake_gce.instance('us-central1-a', 'imgv-0')
+    assert inst['disks'][0]['initializeParams']['sourceImage'] == \
+        'projects/acme/global/images/base'
+    tcfg = ProvisionConfig(
+        cluster_name='imgt', num_nodes=1,
+        resources_config={'accelerators': 'tpu-v5litepod-8',
+                          'infra': 'gcp/us-central1/us-central1-a',
+                          'image_id': 'projects/acme/global/images/base'},
+        region='us-central1', zone='us-central1-a')
+    with pytest.raises(exceptions.InvalidRequestError):
+        provision.run_instances('gcp', tcfg)
+
+
 def test_task_yaml_roundtrip_volumes(tmp_home):
     from skypilot_tpu.task import Task
     cfg = {'name': 'v', 'run': 'echo', 'volumes': {'/mnt/d': 'data'}}
