@@ -105,20 +105,32 @@ class GangJob:
         # Monitor loop: cancellable, and any host's failure is terminal —
         # surviving ranks are killed immediately (a dead host wedges the
         # ICI mesh; peers would otherwise block in collectives forever).
+        # Every exit path joins the log pumps BEFORE returning: the
+        # status callback (and the one-shot log ship behind it) fires the
+        # moment this returns, so a child that exited with its last lines
+        # still in the pipe would otherwise ship truncated/empty logs.
         import time
         while True:
             if self._cancelled:
                 self._kill_all()
+                self._join_pumps(procs)
                 return 130
             rcs = [p.poll() for p in procs]
             first_bad = next(
                 (rc for rc in rcs if rc is not None and rc != 0), None)
             if first_bad is not None:
                 self._kill_all()
+                self._join_pumps(procs)
                 return first_bad
             if all(rc is not None for rc in rcs):
+                self._join_pumps(procs)
                 return 0
             time.sleep(0.2)
+
+    @staticmethod
+    def _join_pumps(procs: List[subprocess.Popen]) -> None:
+        for p in procs:
+            runner_lib.join_pump(p)
 
     def _kill_all(self) -> None:
         import signal

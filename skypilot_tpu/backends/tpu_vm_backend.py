@@ -285,6 +285,17 @@ class TpuVmBackend(backend_lib.Backend):
                 stderr=subprocess.DEVNULL,
                 start_new_session=True)
             handle.extras['agent_pid'] = proc.pid
+            # Registry for test harnesses: every locally spawned agent PID
+            # is appended so a session-scoped fixture can reap leaks (the
+            # agent is detached via start_new_session and survives its
+            # spawner otherwise).
+            registry = os.environ.get('SKYTPU_AGENT_PID_FILE')
+            if registry:
+                try:
+                    with open(registry, 'a', encoding='utf-8') as f:
+                        f.write(f'{proc.pid}\n')
+                except OSError:
+                    pass
         else:
             runner = runner_lib.SSHCommandRunner(handle.head_ip,
                                                  handle.ssh_user,

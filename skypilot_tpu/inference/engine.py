@@ -118,6 +118,11 @@ class DecodeEngine:
         self.cfg = config
         self._rng = jax.random.PRNGKey(config.seed)
         self._prefill_q: 'queue.Queue[Request]' = queue.Queue()
+        # Orders submit()'s error-check-then-enqueue against the crash
+        # path's set-error-then-drain: without it a request enqueued
+        # between those two drain steps is never failed and its tokens()
+        # blocks forever.
+        self._submit_lock = threading.Lock()
         self._slots: List[Optional[_Slot]] = [None] * config.n_slots
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -204,9 +209,6 @@ class DecodeEngine:
     # ----- public API --------------------------------------------------------
     def submit(self, prompt_ids: List[int],
                max_new_tokens: int = 64) -> Request:
-        if self.error is not None:
-            raise RuntimeError(
-                f'decode engine is dead: {self.error!r}')
         max_prompt = self.cfg.prefill_buckets[-1]
         limit = self.model.cfg.max_seq_len
         if len(prompt_ids) > max_prompt or len(prompt_ids) >= limit:
@@ -216,7 +218,11 @@ class DecodeEngine:
         if len(prompt_ids) + max_new_tokens > limit:
             max_new_tokens = limit - len(prompt_ids)
         req = Request(list(prompt_ids), max_new_tokens)
-        self._prefill_q.put(req)
+        with self._submit_lock:
+            if self.error is not None:
+                raise RuntimeError(
+                    f'decode engine is dead: {self.error!r}')
+            self._prefill_q.put(req)
         return req
 
     def generate(self, prompt_ids: List[int],
@@ -318,19 +324,20 @@ class DecodeEngine:
                 # server's /health reports it, so serve's readiness
                 # probes replace this replica).
                 logger.exception('decode engine loop crashed')
-                self.error = e
-                for i, slot in enumerate(self._slots):
-                    if slot is not None:
-                        slot.request.finished_at = time.perf_counter()
-                        slot.request.out.put(None)
-                        self._slots[i] = None
-                while True:
-                    try:
-                        req = self._prefill_q.get_nowait()
-                    except queue.Empty:
-                        break
-                    req.finished_at = time.perf_counter()
-                    req.out.put(None)
+                with self._submit_lock:
+                    self.error = e
+                    for i, slot in enumerate(self._slots):
+                        if slot is not None:
+                            slot.request.finished_at = time.perf_counter()
+                            slot.request.out.put(None)
+                            self._slots[i] = None
+                    while True:
+                        try:
+                            req = self._prefill_q.get_nowait()
+                        except queue.Empty:
+                            break
+                        req.finished_at = time.perf_counter()
+                        req.out.put(None)
                 return
             if n == 0:
                 time.sleep(0.001)

@@ -59,6 +59,29 @@ _GCE_STATE_MAP = {
 
 _CLUSTER_LABEL = 'skytpu-cluster'
 
+# instances.start/resume (and delete-then-recreate of stale spot nodes)
+# are async on the real APIs: for a while after we issue the call the
+# instance still reports its old TERMINATED/SUSPENDED/STOPPED state.
+# run_instances stamps such nodes here so wait_instances treats those
+# states as in-flight (PENDING) instead of spuriously classifying the
+# cluster as failed — which would send the failover engine off to delete
+# a perfectly healthy restarting VM.
+_RESUME_GRACE_S = 120.0
+_recent_restarts: Dict[str, float] = {}
+
+
+def _mark_restarting(node_id: str) -> None:
+    now = time.time()
+    for k in [k for k, t in _recent_restarts.items()
+              if now - t >= _RESUME_GRACE_S]:
+        del _recent_restarts[k]
+    _recent_restarts[node_id] = now
+
+
+def _in_restart_grace(node_id: str) -> bool:
+    t = _recent_restarts.get(node_id)
+    return t is not None and time.time() - t < _RESUME_GRACE_S
+
 
 def _client() -> tpu_client_lib.TpuClient:
     return tpu_client_lib.TpuClient(tpu_client_lib.default_project())
@@ -131,6 +154,7 @@ def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
             continue
         if state in ('STOPPED', 'STOPPING'):
             client.start_node(zone, node_id)
+            _mark_restarting(node_id)
             resumed = True
             continue
         if state in ('PREEMPTED', 'TERMINATED', 'FAILED'):
@@ -138,6 +162,7 @@ def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
             # (reference: sky/clouds/gcp.py:1095-1101).
             client.delete_queued_resource(zone, node_id)
             client.delete_node(zone, node_id)
+            _mark_restarting(node_id)
         if use_qr:
             client.delete_queued_resource(zone, node_id)
             client.create_queued_resource(
@@ -237,6 +262,9 @@ def _run_gce_instances(config: common.ProvisionConfig,
             _check_volumes_attached(inst, name)
             if status == 'STOPPING':
                 client.wait_instance_status(zone, name, ('TERMINATED',))
+            # No grace stamp needed: GCE stale post-start states
+            # (TERMINATED/SUSPENDED) map to InstanceStatus.STOPPED, which
+            # wait_instances already treats as in-flight.
             client.start_instance(zone, name)
             resumed = True
             continue
@@ -365,9 +393,10 @@ def wait_instances(cluster_name: str, region=None, zone=None,
         if all(s is common.InstanceStatus.RUNNING
                for s in statuses.values()):
             return
-        bad = {k: s for k, s in statuses.items() if s in
-               (common.InstanceStatus.PREEMPTED,
-                common.InstanceStatus.TERMINATED)}
+        bad = {k: s for k, s in statuses.items()
+               if s in (common.InstanceStatus.PREEMPTED,
+                        common.InstanceStatus.TERMINATED)
+               and not _in_restart_grace(k)}
         if bad:
             raise exceptions.InsufficientCapacityError(
                 f'instances failed during provisioning: {bad}')
@@ -375,7 +404,7 @@ def wait_instances(cluster_name: str, region=None, zone=None,
             raise exceptions.QueuedResourceTimeoutError(
                 f'cluster {cluster_name} not READY in {timeout_s}s: '
                 f'{statuses}')
-        time.sleep(10.0)
+        time.sleep(float(os.environ.get('SKYTPU_PROVISION_POLL_S', '10')))
     del client
 
 

@@ -143,6 +143,8 @@ async def _version_middleware(request, handler):
 
 
 def make_app() -> web.Application:
+    from skypilot_tpu.utils import auth
+    auth.warn_if_spoofable_rbac(logger)
     app = web.Application(middlewares=[_auth_middleware,
                                        _version_middleware,
                                        _drain_middleware,

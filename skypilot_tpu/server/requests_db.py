@@ -123,7 +123,11 @@ def list_requests(limit: int = 100) -> List[Dict[str, Any]]:
         _ensure(),
         'SELECT request_id FROM requests ORDER BY created_at DESC LIMIT ?',
         (limit,))
-    return [get(r['request_id']) for r in rows]
+    # The requests-GC daemon can prune a terminal row between the id
+    # SELECT and the per-id fetch; drop the resulting Nones so callers
+    # (the GET /requests route) never see a phantom entry.
+    found = (get(r['request_id']) for r in rows)
+    return [req for req in found if req is not None]
 
 
 def nonterminal_requests() -> List[Dict[str, Any]]:
@@ -133,7 +137,8 @@ def nonterminal_requests() -> List[Dict[str, Any]]:
         _ensure(), 'SELECT request_id FROM requests WHERE status IN (?,?) '
         'ORDER BY created_at',
         (RequestStatus.PENDING.value, RequestStatus.RUNNING.value))
-    return [get(r['request_id']) for r in rows]
+    found = (get(r['request_id']) for r in rows)
+    return [req for req in found if req is not None]
 
 
 def prune(max_age_s: float) -> int:

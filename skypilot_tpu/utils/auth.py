@@ -59,3 +59,22 @@ def authenticate(supplied: str) -> Tuple[bool, Optional[str]]:
     # No auth configured: open (single-user/dev), unless per-user
     # tokens exist — then only they grant access.
     return (False, None) if token_users else (True, None)
+
+
+def warn_if_spoofable_rbac(logger) -> bool:
+    """Warn when RBAC (`users:`) is enabled but only a shared token gates
+    the API: any bearer holder can then set X-SkyTPU-User to any name —
+    including an admin's — so ownership checks are spoofable.  Only
+    per-user tokens (``api_server.tokens``) bind identity.  Returns True
+    when the warning fired (tested in tests/test_api_server.py)."""
+    from skypilot_tpu import sky_config
+    rbac_on = bool(sky_config.get_nested(('users',), None))
+    if rbac_on and get_auth_token() and not get_token_users():
+        logger.warning(
+            'RBAC (`users:`) is enabled but only a shared api_server.'
+            'auth_token is configured: identity comes from the client-'
+            'supplied X-SkyTPU-User header, so any token holder can act '
+            'as any user. Configure per-user api_server.tokens to bind '
+            'identity to the bearer.')
+        return True
+    return False

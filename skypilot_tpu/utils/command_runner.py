@@ -30,8 +30,21 @@ def _write_log(log_path: Optional[str], data: bytes) -> None:
 
 def _start_pump(proc: subprocess.Popen, log_path: Optional[str],
                 stream_logs: bool) -> None:
-    """Drain proc stdout into the log file on a daemon thread."""
+    """Drain proc stdout into the log file on a daemon thread.
+
+    The log file is created eagerly (before any output arrives) so
+    consumers that enumerate the log dir after the job turns terminal
+    always see a file — even for jobs that print nothing.  The pump
+    thread is attached to the proc as `skytpu_pump`; callers that
+    declare the job done on `poll()` MUST `join_pump(proc)` first, or
+    they race the final writes (the log-loss bug class: the child has
+    exited but its last lines are still in the pipe)."""
     import threading
+
+    if log_path:
+        os.makedirs(os.path.dirname(log_path) or '.', exist_ok=True)
+        with open(log_path, 'ab'):
+            pass
 
     def pump():
         assert proc.stdout is not None
@@ -40,7 +53,16 @@ def _start_pump(proc: subprocess.Popen, log_path: Optional[str],
             if stream_logs:
                 print(line.decode(errors='replace'), end='')
 
-    threading.Thread(target=pump, daemon=True).start()
+    t = threading.Thread(target=pump, daemon=True)
+    t.start()
+    proc.skytpu_pump = t  # type: ignore[attr-defined]
+
+
+def join_pump(proc: subprocess.Popen, timeout: float = 10.0) -> None:
+    """Wait for a popen()'d proc's output pump to drain (see _start_pump)."""
+    t = getattr(proc, 'skytpu_pump', None)
+    if t is not None:
+        t.join(timeout=timeout)
 
 
 class CommandRunner:

@@ -17,6 +17,10 @@ import jax  # noqa: E402
 
 jax.config.update('jax_platforms', 'cpu')
 
+# Fast provisioning polls against the fake cloud APIs (default 10s is
+# sized for the real GCP control plane).
+os.environ.setdefault('SKYTPU_PROVISION_POLL_S', '0.2')
+
 import pytest  # noqa: E402
 
 
@@ -41,3 +45,42 @@ def tmp_home(tmp_path, monkeypatch):
     sky_config.reset_cache_for_tests()
     yield home
     sky_config.reset_cache_for_tests()
+
+
+@pytest.fixture(scope='session', autouse=True)
+def reap_leaked_agents(tmp_path_factory):
+    """Kill every agent daemon spawned during this test session.
+
+    Agents are started detached (start_new_session=True) so they outlive
+    their spawner; a test that never tears down its cluster leaks one.
+    The backend appends each spawned agent PID to SKYTPU_AGENT_PID_FILE
+    (per pytest/xdist worker, so parallel workers never reap each
+    other's live agents); at session end any PID still running an agent
+    is SIGKILLed.
+    """
+    import signal
+    registry = tmp_path_factory.mktemp('agents') / 'agent-pids.txt'
+    registry.touch()
+    old = os.environ.get('SKYTPU_AGENT_PID_FILE')
+    os.environ['SKYTPU_AGENT_PID_FILE'] = str(registry)
+    yield
+    if old is None:
+        os.environ.pop('SKYTPU_AGENT_PID_FILE', None)
+    else:
+        os.environ['SKYTPU_AGENT_PID_FILE'] = old
+    for line in registry.read_text().splitlines():
+        try:
+            pid = int(line)
+        except ValueError:
+            continue
+        # Only kill PIDs still running OUR agent (guards pid reuse).
+        try:
+            with open(f'/proc/{pid}/cmdline', 'rb') as f:
+                cmdline = f.read()
+        except OSError:
+            continue
+        if b'skypilot_tpu.agent.server' in cmdline:
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass

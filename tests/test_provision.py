@@ -413,3 +413,40 @@ def test_queued_resource_timeout_fails_over(fake_tpu, enable_all_clouds,
     stuck = zones_tried[0]
     assert all(not k.startswith(f'{stuck}/')
                for k in fake_tpu.state.queued)
+
+
+def test_restart_grace_tolerates_stale_terminal_state(fake_tpu):
+    """instances.start / delete-then-recreate are async on the real API:
+    a node we just issued a restart for can still poll TERMINATED.  Within
+    the grace window wait_instances must treat that as in-flight, not
+    spuriously fail the zone (which would delete a healthy restarting
+    node on the failover cleanup path)."""
+    import threading
+    import time as time_lib
+
+    from skypilot_tpu.provision.gcp import instance as gcp_instance
+
+    provision.run_instances('gcp', _tpu_config(cluster='gr'))
+    provision.wait_instances('gcp', 'gr', zone='us-east5-a', timeout_s=30)
+    node = fake_tpu.node('us-east5-a', 'gr-0')
+    node['state'] = 'TERMINATED'
+    # Control: with no restart in flight, TERMINATED fails immediately.
+    gcp_instance._recent_restarts.clear()
+    with pytest.raises(exceptions.InsufficientCapacityError):
+        provision.wait_instances('gcp', 'gr', zone='us-east5-a',
+                                 timeout_s=30)
+    # With the restart stamped, the stale state is waited out.
+    try:
+        gcp_instance._mark_restarting('gr-0')
+
+        def settle():
+            time_lib.sleep(0.5)
+            node['state'] = 'READY'
+
+        th = threading.Thread(target=settle)
+        th.start()
+        provision.wait_instances('gcp', 'gr', zone='us-east5-a',
+                                 timeout_s=30)
+        th.join()
+    finally:
+        gcp_instance._recent_restarts.clear()

@@ -116,9 +116,10 @@ def test_agent_ships_on_job_completion(tmp_home, enable_all_clouds,
     task = Task('ship', run='echo shipped-line')
     task.set_resources(Resources.from_yaml_config({'infra': 'local'}))
     job_id, _ = execution.launch(task, 'shipc', detach_run=False)
-    # Generous deadline: under parallel-suite CPU contention the agent's
-    # post-job shipping step can lag well past the job's completion.
-    deadline = time.time() + 60
+    # Tight deadline on purpose: the gang joins its log pumps before the
+    # job turns terminal, so the ship must be complete (with content)
+    # almost immediately after launch() returns.
+    deadline = time.time() + 10
     shipped = None
     while time.time() < deadline:
         hits = list(sink.rglob('run-0.log'))

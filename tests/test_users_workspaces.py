@@ -236,3 +236,29 @@ def test_jobs_tagged_and_filtered(tmp_home, enable_all_clouds,
             jobs.cancel(job2)
     assert jobs.cancel(job2)
     controller_lib.wait_job(job2, timeout_s=60)
+
+
+# ----- shared-token + RBAC spoofability warning ------------------------------
+def test_warns_when_rbac_relies_on_shared_token(tmp_home, monkeypatch):
+    """Shared token + `users:` RBAC = header-spoofable identity; the
+    server must call this out at startup (only per-user tokens bind
+    identity to the bearer)."""
+    import logging
+
+    from skypilot_tpu.utils import auth
+
+    _write_cfg(tmp_home, 'users:\n  alice: admin\n'
+               'api_server:\n  auth_token: sekrit\n')
+    logger = logging.getLogger('test-auth-warn')
+    assert auth.warn_if_spoofable_rbac(logger) is True
+    # Per-user tokens bind identity: no warning.
+    _write_cfg(tmp_home, 'users:\n  alice: admin\n'
+               'api_server:\n  auth_token: sekrit\n'
+               '  tokens:\n    tok-a: alice\n')
+    from skypilot_tpu import sky_config
+    sky_config.reset_cache_for_tests()
+    assert auth.warn_if_spoofable_rbac(logger) is False
+    # No RBAC: shared token alone is fine.
+    _write_cfg(tmp_home, 'api_server:\n  auth_token: sekrit\n')
+    sky_config.reset_cache_for_tests()
+    assert auth.warn_if_spoofable_rbac(logger) is False
