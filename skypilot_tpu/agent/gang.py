@@ -26,8 +26,11 @@ import shlex
 import subprocess
 from typing import Any, Dict, List, Optional
 
+from skypilot_tpu import sky_logging
 from skypilot_tpu.parallel import distributed
 from skypilot_tpu.utils import command_runner as runner_lib
+
+logger = sky_logging.init_logger(__name__)
 
 
 def build_host_env(host_ips: List[str], host_rank: int,
@@ -128,9 +131,21 @@ class GangJob:
             time.sleep(0.2)
 
     @staticmethod
-    def _join_pumps(procs: List[subprocess.Popen]) -> None:
+    def _join_pumps(procs: List[subprocess.Popen],
+                    deadline: float = 5.0) -> None:
+        """Drain all log pumps under ONE shared deadline (not per-proc:
+        a gang of N hosts must not stack N timeouts onto terminal-status
+        latency when a job leaves a background child holding its pipe).
+        """
+        import time
+        t0 = time.monotonic()
         for p in procs:
-            runner_lib.join_pump(p)
+            left = deadline - (time.monotonic() - t0)
+            if not runner_lib.join_pump(p, timeout=left):
+                logger.warning(
+                    'log pump still draining at terminal status (a '
+                    'background child is holding the output pipe); '
+                    'terminal-time log ship may be missing its output')
 
     def _kill_all(self) -> None:
         import signal

@@ -91,6 +91,14 @@ def main() -> None:
                             'SKYTPU_SERVE_REPLICA_PORT', '8200')))
     parser.add_argument('--n-slots', type=int, default=8)
     parser.add_argument('--max-seq-len', type=int, default=1024)
+    parser.add_argument(
+        '--checkpoint', default=None,
+        help='orbax checkpoint dir (local path or gs://bucket/prefix); '
+        'restores trained params instead of random init')
+    parser.add_argument(
+        '--param-dtype', choices=['float32', 'bfloat16'], default=None,
+        help='cast restored params (bfloat16 halves HBM — required to '
+        'fit 7B-class models on one v5e chip)')
     args = parser.parse_args()
 
     import dataclasses
@@ -99,13 +107,24 @@ def main() -> None:
 
     cfg = dataclasses.replace(LLAMA_CONFIGS[args.model],
                               max_seq_len=args.max_seq_len)
+    if args.param_dtype:
+        cfg = dataclasses.replace(
+            cfg, param_dtype=getattr(jax.numpy, args.param_dtype))
     model = Llama(cfg)
-    params = init_params(model, jax.random.PRNGKey(0))['params']
+    if args.checkpoint:
+        from skypilot_tpu.inference.weights import load_serving_params
+        params = load_serving_params(args.checkpoint,
+                                     dtype=cfg.param_dtype)
+    else:
+        logger.warning('no --checkpoint given: serving RANDOM-INIT params '
+                       '(demo mode)')
+        params = init_params(model, jax.random.PRNGKey(0))['params']
     engine = DecodeEngine(model, params,
                           EngineConfig(n_slots=args.n_slots))
     engine.start()
     logger.info(f'serving {args.model} on :{args.port} '
-                f'({args.n_slots} slots)')
+                f'({args.n_slots} slots, '
+                f'checkpoint={args.checkpoint or "random-init"})')
     web.run_app(build_app(engine), port=args.port, print=None)
 
 

@@ -1,0 +1,106 @@
+"""Checkpoint loading for serve replicas.
+
+The reference's serving story is convert-a-trained-checkpoint-then-serve
+(/root/reference/examples/tpu/v6e/README.md:100-118: convert Llama
+weights into a bucket, point the JetStream server at it).  Here the
+equivalent is: a training run checkpoints via orbax
+(train/checkpoint.py), and the serve replica restores the params at
+startup — from a local directory or straight from a `gs://` bucket.
+
+No conversion step is needed: train and serve share the same Flax
+parameter tree, and orbax restores onto whatever topology the replica
+has (single chip or a sharded mesh).
+"""
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Any, Optional
+
+import jax
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+
+def _materialize_local(path: str) -> str:
+    """Return a local directory holding the checkpoint.
+
+    `gs://bucket/prefix` paths are synced down to a temp dir first
+    (gsutil, or the fake-GCS root under tests — data/storage.py).
+    Local paths are returned as-is.
+    """
+    if path.startswith('gs://'):
+        from skypilot_tpu.data import storage as storage_lib
+        rest = path[len('gs://'):]
+        bucket, _, prefix = rest.partition('/')
+        local = tempfile.mkdtemp(prefix='skytpu-ckpt-')
+        logger.info(f'fetching checkpoint {path} -> {local}')
+        storage_lib.GcsStore(bucket).sync_down(local, prefix)
+        return local
+    return os.path.abspath(os.path.expanduser(path))
+
+
+def _cleanup_fetched(path: str, local: str) -> None:
+    """Remove the temp download for gs:// restores (a crash-looping
+    replica must not fill /tmp with multi-GB checkpoint copies)."""
+    if local != os.path.abspath(os.path.expanduser(path)):
+        import shutil
+        shutil.rmtree(local, ignore_errors=True)
+
+
+def load_serving_params(path: str, step: Optional[int] = None,
+                        dtype: Any = None) -> Any:
+    """Restore model params from an orbax checkpoint directory.
+
+    Accepts either a params-only checkpoint or a full TrainState
+    checkpoint (train/trainer.py saves the latter); for a TrainState the
+    optimizer state is discarded — serving only needs `params`.
+
+    The restore is *topology-independent*: a checkpoint written on an
+    8-chip training mesh restores onto a single-chip serve replica (or
+    any other device set).  Orbax's default restore re-applies the
+    *saved* shardings and hard-fails when the saved device mesh differs
+    from the replica's — precisely the production case (train sharded,
+    serve single-chip) — so every leaf is restored to host numpy via
+    per-leaf RestoreArgs and the params are then device_put, optionally
+    cast to `dtype` (pass jnp.bfloat16 to halve HBM for big models).
+    """
+    import numpy as np
+    import orbax.checkpoint as ocp
+
+    local = _materialize_local(path)
+    try:
+        mgr = ocp.CheckpointManager(local)
+        if step is None:
+            step = mgr.latest_step()
+        mgr.close()
+        if step is None:
+            raise FileNotFoundError(
+                f'no checkpoint steps found under {path!r} '
+                f'(resolved to {local!r})')
+        logger.info(f'restoring checkpoint step {step} from {path}')
+        step_dir = os.path.join(local, str(step), 'default')
+        ckptr = ocp.PyTreeCheckpointer()
+        meta = ckptr.metadata(step_dir).item_metadata.tree
+        is_leaf = lambda x: hasattr(x, 'dtype') and hasattr(x, 'shape')  # noqa: E731,E501
+        restore_args = jax.tree.map(
+            lambda _: ocp.RestoreArgs(restore_type=np.ndarray), meta,
+            is_leaf=is_leaf)
+        restored = ckptr.restore(
+            step_dir,
+            args=ocp.args.PyTreeRestore(restore_args=restore_args))
+    finally:
+        _cleanup_fetched(path, local)
+    # TrainState layout: {'params': ..., 'opt_state': ..., 'step': ...}
+    if isinstance(restored, dict) and 'params' in restored:
+        restored = restored['params']
+
+    def _put(x):
+        if dtype is not None and jax.numpy.issubdtype(x.dtype,
+                                                      jax.numpy.floating):
+            x = x.astype(dtype)
+        return jax.device_put(x)
+
+    return jax.tree.map(_put, restored)

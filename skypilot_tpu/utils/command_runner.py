@@ -58,11 +58,20 @@ def _start_pump(proc: subprocess.Popen, log_path: Optional[str],
     proc.skytpu_pump = t  # type: ignore[attr-defined]
 
 
-def join_pump(proc: subprocess.Popen, timeout: float = 10.0) -> None:
-    """Wait for a popen()'d proc's output pump to drain (see _start_pump)."""
+def join_pump(proc: subprocess.Popen, timeout: float = 10.0) -> bool:
+    """Wait for a popen()'d proc's output pump to drain (see _start_pump).
+
+    Returns False when the pump is still running at the deadline — the
+    case where the exited child left a background grandchild holding the
+    write end of the pipe (`my_daemon & exit`): the pump keeps draining
+    on its daemon thread, but logs shipped at terminal time may be
+    missing that daemon's later output.
+    """
     t = getattr(proc, 'skytpu_pump', None)
     if t is not None:
-        t.join(timeout=timeout)
+        t.join(timeout=max(timeout, 0.0))
+        return not t.is_alive()
+    return True
 
 
 class CommandRunner:

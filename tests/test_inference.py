@@ -183,3 +183,88 @@ def test_http_server_completions(model_and_params):
     want = naive_greedy(model, params, encode_bytes('hi'), 4)
     # HTTP path produced real engine tokens
     assert want  # sanity: reference generation nonempty
+
+
+def test_serve_trained_checkpoint(tmp_path, monkeypatch):
+    """Train -> checkpoint -> serve restores the TRAINED weights.
+
+    The reference's serve flow is checkpoint-convert-then-serve
+    (examples/tpu/v6e/README.md:100-118); here the replica restores the
+    orbax checkpoint directly.  Covers both a local path and a gs://
+    path over the fake-GCS boundary, and proves the replica serves the
+    trained tree (leaf-exact restore, != random init); engine-vs-naive
+    decode parity is covered by the engine tests above.
+    """
+    from skypilot_tpu.inference.weights import load_serving_params
+    from skypilot_tpu.parallel.mesh import MeshPlan, build_mesh
+    from skypilot_tpu.train.trainer import TrainConfig, Trainer
+
+    mesh = build_mesh(MeshPlan(1, 8, 1))
+    model = Llama(CFG)
+    sample = jnp.zeros((8, 32), jnp.int32)
+    ckpt_dir = tmp_path / 'ckpt'
+    trainer = Trainer(model, mesh, jax.random.PRNGKey(0), sample,
+                      TrainConfig(learning_rate=1e-2, warmup_steps=1,
+                                  total_steps=4),
+                      checkpoint_dir=str(ckpt_dir))
+
+    def batches():
+        key = jax.random.PRNGKey(1)
+        while True:
+            key, sub = jax.random.split(key)
+            yield jax.random.randint(sub, (8, 32), 0, CFG.vocab_size)
+
+    trainer.run(batches(), 3)
+    trainer.save_checkpoint()
+    trainer._ckpt_mgr.close()
+    trained = jax.device_get(trainer.state.params)
+
+    # Local-path restore returns exactly the trained tree.
+    restored = load_serving_params(str(ckpt_dir))
+    assert (jax.tree.structure(restored) == jax.tree.structure(trained))
+    for got, want in zip(jax.tree.leaves(restored),
+                         jax.tree.leaves(trained), strict=True):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want))
+
+    # The trained tree is not the random init the old server fell back to.
+    rand = init_params(model, jax.random.PRNGKey(0))['params']
+    diffs = [not np.allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+             for a, b in zip(jax.tree.leaves(restored),
+                             jax.tree.leaves(rand))]
+    assert any(diffs)
+
+    # gs:// restore through the fake-GCS boundary (bucket -> replica).
+    monkeypatch.setenv('SKYTPU_FAKE_GCS_ROOT', str(tmp_path / 'gcs'))
+    from skypilot_tpu.data import storage as storage_lib
+    bucket = storage_lib.GcsStore('ckpts')
+    bucket.create()
+    bucket.sync_up(str(ckpt_dir), 'run1')
+    params_gs = load_serving_params('gs://ckpts/run1')
+    assert (jax.tree.structure(params_gs) == jax.tree.structure(trained))
+    for got, want in zip(jax.tree.leaves(params_gs),
+                         jax.tree.leaves(trained), strict=True):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want))
+
+    # The engine decodes with the restored weights end-to-end.  (Exact
+    # engine-vs-naive token equality is asserted elsewhere on random
+    # init; a briefly-trained tiny model has near-tie logits where the
+    # two numeric paths may argmax apart, so only completion shape and
+    # determinism are asserted here.)
+    engine = DecodeEngine(model, params_gs,
+                          EngineConfig(n_slots=1, prefill_buckets=(8,)))
+    prompt = [5, 17, 3]
+    req = engine.submit(prompt, 6)
+    while req.finished_at is None:
+        engine.step()
+    first = req.tokens()
+    assert len(first) == 6
+    req2 = engine.submit(prompt, 6)
+    while req2.finished_at is None:
+        engine.step()
+    assert req2.tokens() == first  # greedy decode is deterministic
+
+
+def test_load_serving_params_missing(tmp_path):
+    from skypilot_tpu.inference.weights import load_serving_params
+    with pytest.raises(FileNotFoundError):
+        load_serving_params(str(tmp_path / 'empty'))
