@@ -127,8 +127,20 @@ class DecodeEngine:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.error: Optional[BaseException] = None
+        self._fmt_params = None
+        self._prefill_compiled: Dict[tuple, Any] = {}
         self._build_fns()
         self._init_cache()
+        if jax.default_backend() == 'tpu':
+            try:
+                self._optimize_layouts()
+            except Exception:  # pylint: disable=broad-except
+                # Degraded but functional: decode relays out weights as
+                # HLO temps (extra HBM). Big models may OOM — but never
+                # refuse to serve because a layout API changed.
+                logger.exception('param layout optimization failed; '
+                                 'serving with default layouts')
+                self._fmt_params = None
 
     @property
     def healthy(self) -> bool:
@@ -144,25 +156,38 @@ class DecodeEngine:
             return jnp.argmax(logits, axis=-1)
 
         def prefill_insert(params, big_cache, last_toks, lens, tokens,
-                           length, slot, rng):
-            """Fused prefill + slot insert, one dispatch, nothing synced.
-            tokens [1, P(bucket)]."""
-            positions = jnp.arange(tokens.shape[1])[None, :]
+                           lengths, slots, valid, rng):
+            """Fused BATCHED prefill + slot insert: N prompts of one
+            bucket in ONE dispatch, nothing synced.  tokens [N, P],
+            lengths [N], slots [N], valid [N].  N is padded to a power
+            of two by replicating row 0 (`valid`=0 for padding rows);
+            batching the prefill keeps the MXU on one big [N*P] matmul
+            instead of N small ones — the TTFT lever under admission
+            bursts."""
+            n, p = tokens.shape
+            positions = jnp.broadcast_to(jnp.arange(p)[None, :], (n, p))
             logits, cache = model.apply(
                 {'params': params}, tokens, positions=positions,
                 decode=True, mutable=['cache'])
-            last = jax.lax.dynamic_index_in_dim(logits, length - 1, axis=1,
-                                                keepdims=False)  # [1, V]
-            first = sample(last, rng)[0]                          # scalar
+            last = jnp.take_along_axis(
+                logits, (lengths - 1)[:, None, None], axis=1)[:, 0]  # [N,V]
+            firsts = sample(last, rng)                               # [N]
+            # Padding rows replicate row 0, so their duplicate scatter
+            # writes must carry row 0's VALUE too — under temperature
+            # sampling each row draws independently, and XLA leaves
+            # which duplicate-index write wins unspecified.
+            firsts = jnp.where(valid.astype(bool), firsts, firsts[0])
 
             def _ins(big, small):
-                idx = (slot,) + (0,) * (big.ndim - 1)
-                return jax.lax.dynamic_update_slice(big, small, idx)
+                # small [N, H, max_len, D] rows (the model's prefill
+                # cache is already full-length) scattered into big
+                # [n_slots, H, max_len, D] at each row's slot index.
+                return big.at[slots].set(small)
 
             big_cache = jax.tree_util.tree_map(_ins, big_cache,
                                                cache['cache'])
-            return (big_cache, last_toks.at[slot].set(first),
-                    lens.at[slot].set(length))
+            return (big_cache, last_toks.at[slots].set(firsts),
+                    lens.at[slots].set(lengths))
 
         steps = self.cfg.steps_per_call
         max_len = model.cfg.max_seq_len
@@ -189,6 +214,8 @@ class DecodeEngine:
             out = jnp.concatenate([last_tokens[None, :], toks], axis=0)
             return out, cache, last, lens                    # [T+1, B]
 
+        self._prefill_raw = prefill_insert
+        self._decode_raw = decode
         self._prefill_insert = jax.jit(prefill_insert,
                                        donate_argnums=(1, 2, 3))
         self._decode = jax.jit(decode, donate_argnums=(1, 2, 3))
@@ -205,6 +232,84 @@ class DecodeEngine:
         # Device-resident engine state: synced host-ward once per step.
         self._last_d = jnp.zeros((n,), jnp.int32)
         self._lens_d = jnp.zeros((n,), jnp.int32)
+
+    def _optimize_layouts(self):
+        """TPU: pre-lay-out the weights the way the decode loop wants.
+
+        For 3D projection kernels (e.g. [embed, heads, head_dim]) the
+        decode matvecs prefer a different tiled layout than the default;
+        left alone, XLA materializes a relaid-out copy of EVERY weight
+        as an HLO temp of the decode program — ~3 GB extra HBM for a 7B,
+        the difference between fitting one v5e chip and OOM.  Fix: AOT-
+        compile the decode step with AUTO input layouts, then device_put
+        params (and the cache/engine state, which must match since they
+        are donated through the same executable) into the layouts the
+        compiler chose.  Prefill executables are then pinned to those
+        same layouts per bucket in _admit_group.
+        """
+        from jax.experimental.layout import Format, Layout
+
+        def _abs(tree):
+            return jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), tree)
+
+        auto = jax.tree.map(lambda _: Format(Layout.AUTO), self.params)
+        rng_abs = jax.ShapeDtypeStruct(self._rng.shape, self._rng.dtype)
+        compiled = jax.jit(
+            self._decode_raw, donate_argnums=(1, 2, 3),
+            in_shardings=(auto, Format(Layout.AUTO), Format(Layout.AUTO),
+                          Format(Layout.AUTO), Format(Layout.AUTO)),
+            # Donated inputs require matching AUTO outputs (out row 0 is
+            # host-fetched; its layout is immaterial).
+            out_shardings=(Format(Layout.AUTO), Format(Layout.AUTO),
+                           Format(Layout.AUTO), Format(Layout.AUTO)),
+        ).lower(_abs(self.params), _abs(self._cache), _abs(self._last_d),
+                _abs(self._lens_d), rng_abs).compile()
+        fmts, _ = compiled.input_formats
+        self._fmt_params, self._fmt_cache = fmts[0], fmts[1]
+        self._fmt_last, self._fmt_lens = fmts[2], fmts[3]
+        # donate=True: relayout leaf-by-leaf in place — without it the
+        # whole param tree exists twice mid-put (2x 13.3 GB for a 7B).
+        self.params = jax.device_put(self.params, self._fmt_params,
+                                     donate=True)
+        self._cache = jax.device_put(self._cache, self._fmt_cache,
+                                     donate=True)
+        self._last_d = jax.device_put(self._last_d, self._fmt_last,
+                                      donate=True)
+        self._lens_d = jax.device_put(self._lens_d, self._fmt_lens,
+                                      donate=True)
+        self._decode = compiled
+
+    def _prefill_for(self, bucket: int, padded_n: int):
+        """Prefill executable for one (bucket, batch) shape, pinned to
+        the decode-chosen param/cache layouts on TPU (plain jit
+        elsewhere)."""
+        if self._fmt_params is None:
+            return self._prefill_insert
+        key = (bucket, padded_n)
+        fn = self._prefill_compiled.get(key)
+        if fn is None:
+            def _abs(tree):
+                return jax.tree.map(
+                    lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), tree)
+
+            toks = jax.ShapeDtypeStruct((padded_n, bucket), jnp.int32)
+            vec = jax.ShapeDtypeStruct((padded_n,), jnp.int32)
+            rng_abs = jax.ShapeDtypeStruct(self._rng.shape, self._rng.dtype)
+            fn = jax.jit(
+                self._prefill_raw, donate_argnums=(1, 2, 3),
+                in_shardings=(self._fmt_params, self._fmt_cache,
+                              self._fmt_last, self._fmt_lens,
+                              None, None, None, None, None),
+                # Outputs feed the next decode call via donation — they
+                # must come back in the decode-chosen layouts.
+                out_shardings=(self._fmt_cache, self._fmt_last,
+                               self._fmt_lens),
+            ).lower(_abs(self.params), _abs(self._cache),
+                    _abs(self._last_d), _abs(self._lens_d), toks, vec, vec,
+                    vec, rng_abs).compile()
+            self._prefill_compiled[key] = fn
+        return fn
 
     # ----- public API --------------------------------------------------------
     def submit(self, prompt_ids: List[int],
@@ -230,6 +335,25 @@ class DecodeEngine:
         """Synchronous helper: submit and wait."""
         return self.submit(prompt_ids, max_new_tokens).tokens()
 
+    def prewarm(self) -> None:
+        """Compile every prefill shape up front (TPU layout path only).
+
+        Admission pads groups to powers of two, so the shape set is
+        |buckets| x (log2(n_slots)+1).  Without this, the first burst
+        that hits a new shape stalls the whole decode batch behind a
+        multi-second XLA compile — a mid-traffic TTFT/TPOT spike.
+        """
+        if self._fmt_params is None:
+            return
+        n = 1
+        sizes = []
+        while n <= self.cfg.n_slots:
+            sizes.append(n)
+            n *= 2
+        for bucket in self.cfg.prefill_buckets:
+            for size in sizes:
+                self._prefill_for(bucket, size)
+
     def start(self):
         self._thread = threading.Thread(target=self._loop,
                                         name='decode-engine', daemon=True)
@@ -252,17 +376,42 @@ class DecodeEngine:
         return sub
 
     def _admit(self, slot_id: int, req: Request) -> None:
-        """Dispatch prefill+insert; does NOT sync — the first token is
-        emitted from row 0 of the next decode call's output."""
-        plen = len(req.prompt_ids)
-        bucket = self._bucket(plen)
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, :plen] = req.prompt_ids
-        self._cache, self._last_d, self._lens_d = self._prefill_insert(
+        """Single-request admission (tests/back-compat); batched path
+        is _admit_group."""
+        self._admit_group(self._bucket(len(req.prompt_ids)),
+                          [(slot_id, req)])
+
+    def _admit_group(self, bucket: int, group) -> None:
+        """Dispatch ONE batched prefill+insert for all (slot, request)
+        pairs of a bucket; does NOT sync — each first token is emitted
+        from row 0 of the next decode call's output.
+
+        The group is padded to a power-of-two row count (few compiled
+        shapes: |buckets| x log2(n_slots)); padding replicates row 0,
+        whose duplicate scatter writes are identical-value no-ops.
+        """
+        n = len(group)
+        padded_n = 1 << (n - 1).bit_length()
+        tokens = np.zeros((padded_n, bucket), np.int32)
+        lengths = np.zeros((padded_n,), np.int32)
+        slots = np.zeros((padded_n,), np.int32)
+        valid = np.zeros((padded_n,), np.int32)
+        for j, (slot_id, req) in enumerate(group):
+            plen = len(req.prompt_ids)
+            tokens[j, :plen] = req.prompt_ids
+            lengths[j] = plen
+            slots[j] = slot_id
+            valid[j] = 1
+        tokens[n:] = tokens[0]
+        lengths[n:] = lengths[0]
+        slots[n:] = slots[0]
+        prefill = self._prefill_for(bucket, padded_n)
+        self._cache, self._last_d, self._lens_d = prefill(
             self.params, self._cache, self._last_d, self._lens_d,
-            jnp.asarray(padded), plen, jnp.asarray(slot_id),
-            self._next_rng())
-        self._slots[slot_id] = _Slot(req, plen)
+            jnp.asarray(tokens), jnp.asarray(lengths), jnp.asarray(slots),
+            jnp.asarray(valid), self._next_rng())
+        for slot_id, req in group:
+            self._slots[slot_id] = _Slot(req, len(req.prompt_ids))
 
     def _emit(self, req: Request, tok: int) -> None:
         req.emitted += 1
@@ -281,13 +430,19 @@ class DecodeEngine:
     def step(self) -> int:
         """One engine iteration (admit + decode).  Returns #active slots.
         Exposed for tests and for single-threaded benchmarking."""
-        for i in range(self.cfg.n_slots):
-            if self._slots[i] is None and not self._prefill_q.empty():
-                try:
-                    req = self._prefill_q.get_nowait()
-                except queue.Empty:
-                    break
-                self._admit(i, req)
+        free = [i for i in range(self.cfg.n_slots)
+                if self._slots[i] is None]
+        by_bucket: Dict[int, list] = {}
+        while free and not self._prefill_q.empty():
+            try:
+                req = self._prefill_q.get_nowait()
+            except queue.Empty:
+                break
+            by_bucket.setdefault(
+                self._bucket(len(req.prompt_ids)), []).append(
+                    (free.pop(0), req))
+        for bucket, group in by_bucket.items():
+            self._admit_group(bucket, group)
         active = [i for i in range(self.cfg.n_slots)
                   if self._slots[i] is not None]
         if not active:

@@ -121,6 +121,9 @@ def main() -> None:
         params = init_params(model, jax.random.PRNGKey(0))['params']
     engine = DecodeEngine(model, params,
                           EngineConfig(n_slots=args.n_slots))
+    # Compile every prefill shape before taking traffic — a mid-burst
+    # XLA compile would stall the whole decode batch for seconds.
+    engine.prewarm()
     engine.start()
     logger.info(f'serving {args.model} on :{args.port} '
                 f'({args.n_slots} slots, '

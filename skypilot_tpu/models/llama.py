@@ -262,14 +262,15 @@ class Attention(nn.Module):
             cv.value = jax.lax.dynamic_update_slice(
                 cv.value, v, (0, 0, 0, 0))
             return k, v, attn_lib.mha_reference(q, k, v, causal=True)
-        # Steady state (S == 1 per slot): scatter-write each slot's k/v at
-        # its own position via a one-hot blend (elementwise over the
-        # cache — the same HBM traffic the attention read pays anyway).
+        # Steady state (S == 1 per slot): scatter-write each slot's k/v
+        # at its own position.  A true scatter (not a one-hot blend —
+        # that reads+writes the whole cache and double-buffers it as an
+        # HLO temp inside the decode scan, ~2x cache HBM; scatter
+        # updates one row in place under donation).
         pos = positions[:, 0]                                   # [B]
-        oh = jax.nn.one_hot(pos, max_len, dtype=ck.value.dtype)  # [B, L]
-        oh = oh[:, None, :, None]                               # [B,1,L,1]
-        ck.value = ck.value * (1.0 - oh) + k * oh
-        cv.value = cv.value * (1.0 - oh) + v * oh
+        b_idx = jnp.arange(b)
+        ck.value = ck.value.at[b_idx, :, pos, :].set(k[:, :, 0, :])
+        cv.value = cv.value.at[b_idx, :, pos, :].set(v[:, :, 0, :])
         k_all, v_all = ck.value, cv.value
         k_pos = jnp.arange(max_len)[None, :]
         out = attn_lib.mha_reference(

@@ -61,6 +61,29 @@ def test_engine_continuous_batching_staggered(model_and_params):
     assert r2.tokens() == want2
 
 
+def test_engine_batched_admission_burst(model_and_params):
+    """A burst of requests admitted in one step() — mixed buckets, odd
+    group sizes (exercises the power-of-two padding rows) — must each
+    reproduce naive greedy exactly."""
+    model, params = model_and_params
+    engine = DecodeEngine(model, params,
+                          EngineConfig(n_slots=8, prefill_buckets=(8, 16),
+                                       steps_per_call=2))
+    prompts = [[1, 2, 3],                      # bucket 8
+               [4, 5, 6, 7, 8],                # bucket 8
+               [9, 10, 11],                    # bucket 8 (group of 3)
+               list(range(20, 30)),            # bucket 16
+               [13, 14, 15, 16, 17, 18, 19, 20, 21]]   # bucket 16
+    wants = [naive_greedy(model, params, p, 6) for p in prompts]
+    reqs = [engine.submit(p, 6) for p in prompts]
+    # All five must be admitted by the FIRST step (burst admission).
+    engine.step()
+    assert sum(s is not None for s in engine._slots) == 5
+    while any(r.finished_at is None for r in reqs):
+        engine.step()
+    assert [r.tokens() for r in reqs] == wants
+
+
 def test_engine_slot_reuse_no_kv_leak(model_and_params):
     # A request admitted into a previously-used slot must generate
     # exactly what it would in a fresh engine (insert overwrites the
