@@ -9,7 +9,10 @@ per-tick error isolation.  Current roster:
 - job-log GC: prune log directories of long-finished jobs so a
   months-lived cluster's disk doesn't fill with per-rank logs
   (shipped copies live in the external sink — logs/ — when
-  configured).
+  configured);
+- streaming log ship: incremental (offset-tracked) ship of RUNNING
+  jobs' logs, so a preempted/crashed host's partial logs survive in
+  the sink (ref streams via fluentbit: sky/logs/agent.py:31).
 """
 from __future__ import annotations
 
@@ -31,6 +34,27 @@ def _log_retention_s() -> float:
                                 '168')) * 3600.0
 
 
+def ship_running_job_logs() -> int:
+    """Incrementally ship every active job's logs to the configured
+    sink (no-op when shipping is off); returns #jobs shipped."""
+    from skypilot_tpu import logs as logs_lib
+    if logs_lib.shipping_config() is None:
+        return 0
+    cluster = os.environ.get('SKYTPU_CLUSTER_NAME')
+    shipped = 0
+    # Unbounded scan (same rationale as gc_job_logs): a week-long job
+    # must keep streaming even after 1000 newer submissions.
+    for job in job_queue.list_jobs(limit=1 << 30):
+        if job['status'] not in (job_queue.JobStatus.RUNNING,
+                                 job_queue.JobStatus.SETTING_UP):
+            continue
+        log_dir = job_queue.log_dir(job['job_id'])
+        if os.path.isdir(log_dir) and logs_lib.ship_incremental(
+                cluster, job['job_id'], log_dir):
+            shipped += 1
+    return shipped
+
+
 def gc_job_logs() -> int:
     """Delete log dirs of jobs that finished more than the retention
     window ago; returns how many were pruned."""
@@ -46,6 +70,12 @@ def gc_job_logs() -> int:
         if os.path.isdir(log_dir):
             shutil.rmtree(log_dir, ignore_errors=True)
             pruned += 1
+        # The streaming-ship offset state lives next to the log dir;
+        # prune it too or it accumulates one file per job forever.
+        from skypilot_tpu import logs as logs_lib
+        state = logs_lib.offsets_state_path(log_dir, job['job_id'])
+        if os.path.isfile(state):
+            os.unlink(state)
     if pruned:
         logger.info(f'log-gc: pruned {pruned} finished-job log dirs')
     return pruned
@@ -65,6 +95,7 @@ class EventLoop(threading.Thread):
             ('autostop',
              lambda: autostop_lib.maybe_enforce(identity, started_at)),
             ('log-gc', gc_job_logs),
+            ('log-ship', ship_running_job_logs),
         ]
 
     def stop(self) -> None:

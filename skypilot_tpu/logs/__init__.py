@@ -18,13 +18,21 @@ fake root in tests).
 """
 from __future__ import annotations
 
+import json
 import os
 import shutil
+import threading
 from typing import Any, Dict, Optional
 
 from skypilot_tpu import sky_logging
 
 logger = sky_logging.init_logger(__name__)
+
+# Serializes the event-loop incremental ship against the job-thread
+# terminal ship; both use the same offset-append core, so whichever
+# runs second ships only the remaining delta (no overwrite, no
+# duplicated tail).
+_ship_lock = threading.Lock()
 
 
 def shipping_config() -> Optional[Dict[str, Any]]:
@@ -58,6 +66,89 @@ def ship_job_logs(cluster_name: Optional[str], job_id: int,
         return None
 
 
+def ship_incremental(cluster_name: Optional[str], job_id: int,
+                     log_dir: str) -> Optional[str]:
+    """Periodic partial ship for a RUNNING job.
+
+    The terminal-state ship (ship_job_logs) alone loses everything when
+    a host is preempted or crashes mid-job — exactly when the logs
+    matter most (ref streams continuously via fluentbit:
+    sky/logs/agent.py:31).  This runs on the agent event loop
+    (agent/events.py): the `file` sink gets offset-tracked appends (only
+    bytes past the last shipped offset move, via the same core the
+    terminal ship finalizes through); the `gcs` sink re-syncs the
+    directory (gsutil rsync skips unchanged files).  Never raises.
+    """
+    try:
+        cfg = shipping_config()
+        if not isinstance(cfg, dict):
+            return None
+        cluster_name = cluster_name or 'cluster'
+        store = cfg['store']
+        if store == 'gcs':
+            return _ship(cfg, cluster_name, job_id, log_dir)
+        if store != 'file':
+            raise ValueError(f'unknown log store {store!r} (file|gcs)')
+        with _ship_lock:
+            return _ship_file_delta(cfg, cluster_name, job_id, log_dir)
+    except Exception as e:  # pylint: disable=broad-except
+        logger.warning(
+            f'incremental log ship for job {job_id} failed: {e}')
+        return None
+
+
+def offsets_state_path(log_dir: str, job_id: int) -> str:
+    """Offset state lives NEXT TO the log dir (never shipped with it);
+    the log-GC event unlinks it together with the log dir."""
+    return os.path.join(os.path.dirname(log_dir.rstrip('/')),
+                        f'.ship-offsets-{job_id}.json')
+
+
+def _ship_file_delta(cfg: Dict[str, Any], cluster_name: str, job_id: int,
+                     log_dir: str) -> str:
+    """Offset-append core for the file sink — shared by the periodic
+    incremental ship and the terminal ship (the terminal call just
+    ships the final delta).  Caller holds _ship_lock."""
+    prefix = (cfg.get('prefix') or '').strip('/')
+    rel = '/'.join(p for p in (prefix, cluster_name, f'job-{job_id}')
+                   if p)
+    base = os.path.expanduser(cfg.get('path') or '~/skytpu-logs')
+    dst = os.path.join(base, rel)
+    os.makedirs(dst, exist_ok=True)
+    state_path = offsets_state_path(log_dir, job_id)
+    offsets: Dict[str, int] = {}
+    if os.path.isfile(state_path):
+        with open(state_path, encoding='utf-8') as f:
+            offsets = json.load(f)
+    for entry in sorted(os.listdir(log_dir)):
+        src = os.path.join(log_dir, entry)
+        if not os.path.isfile(src):
+            continue
+        size = os.path.getsize(src)
+        off = int(offsets.get(entry, 0))
+        if size <= off:
+            continue
+        with open(src, 'rb') as sf, \
+                open(os.path.join(dst, entry), 'ab') as df:
+            sf.seek(off)
+            # Copy exactly [off, size): the job may still be appending,
+            # and copying to live EOF while recording `size` as the
+            # offset would re-ship the overrun next tick.
+            remaining = size - off
+            while remaining > 0:
+                chunk = sf.read(min(1 << 20, remaining))
+                if not chunk:
+                    break
+                df.write(chunk)
+                remaining -= len(chunk)
+        offsets[entry] = size
+    tmp = state_path + '.tmp'
+    with open(tmp, 'w', encoding='utf-8') as f:
+        json.dump(offsets, f)
+    os.replace(tmp, state_path)
+    return dst
+
+
 def _ship(cfg: Dict[str, Any], cluster_name: str, job_id: int,
           log_dir: str) -> str:
     prefix = (cfg.get('prefix') or '').strip('/')
@@ -65,13 +156,12 @@ def _ship(cfg: Dict[str, Any], cluster_name: str, job_id: int,
                    if p)
     store = cfg['store']
     if store == 'file':
-        base = os.path.expanduser(cfg.get('path') or '~/skytpu-logs')
-        dst = os.path.join(base, rel)
-        os.makedirs(dst, exist_ok=True)
-        for entry in os.listdir(log_dir):
-            src = os.path.join(log_dir, entry)
-            if os.path.isfile(src):
-                shutil.copy2(src, os.path.join(dst, entry))
+        # Same offset-append core as the periodic incremental ship: the
+        # terminal call ships whatever delta remains (everything, when
+        # streaming was never ticked), so the two paths can never
+        # overwrite each other or duplicate a tail.
+        with _ship_lock:
+            dst = _ship_file_delta(cfg, cluster_name, job_id, log_dir)
         logger.info(f'job {job_id} logs shipped to {dst}')
         return dst
     if store == 'gcs':

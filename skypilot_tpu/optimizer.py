@@ -5,9 +5,10 @@ Same contract as the reference `Optimizer.optimize(dag, minimize=COST|TIME)`
 candidates across enabled clouds (`_fill_in_launchable_resources`,
 reference :1319), estimate per-candidate cost and run time, then pick the
 globally optimal assignment.  Chain DAGs use exact DP over (task, candidate)
-states with inter-task egress edge costs (reference :429); general DAGs fall
-back to per-task greedy (the reference uses a pulp ILP, :490 — pulp is not in
-this environment, and chains cover the launch/jobs/serve paths).
+states with inter-task egress edge costs (reference :429); general DAGs use
+exact branch-and-bound over the same state space (the reference uses a pulp
+ILP, :490 — pulp is not in this environment; DAGs are small enough for an
+exact search with an admissible bound).
 
 TPU-native twist: TIME minimization uses the slice's aggregate bf16 FLOP/s
 from the accelerator registry to scale estimated runtimes, so `minimize=TIME`
@@ -296,19 +297,100 @@ class Optimizer:
             tasks[i].best_resources = all_cands[i][last][0]
             last = dp[i][last][1]
 
+    # Expansion cap for the exact search: beyond this the incumbent
+    # (greedy) assignment is kept.  DAGs here are small (the reference's
+    # pulp ILP solves the same shape, sky/optimizer.py:490); the cap is
+    # a safety net against pathological candidate fan-out, not a tuning
+    # knob.
+    _BNB_MAX_EXPANSIONS = 2_000_000
+
     @classmethod
     def _optimize_general(
         cls, dag: dag_lib.Dag, minimize: OptimizeTarget,
         blocked_resources: Optional[List[resources_lib.Resources]],
     ) -> None:
-        """Per-task greedy for non-chain DAGs (the reference's ILP handles
-        egress globally; without pulp, per-task optimal ignoring edges)."""
-        for task in dag.topological_order():
-            cands = cls._candidates_with_metrics(task, blocked_resources)
-            task.best_resources = min(
-                cands,
-                key=lambda x: cls._objective(minimize, task, x[0], x[1],
-                                             x[2], x[3]))[0]
+        """Exact search for non-chain DAGs: branch-and-bound over
+        per-task candidate sets with egress edge costs.
+
+        The reference solves this placement as a pulp ILP
+        (sky/optimizer.py:490-543); pulp is not in this environment, and
+        the DAGs are small, so an exact DFS with an admissible lower
+        bound (remaining tasks' best node objectives; egress >= 0) finds
+        the same optimum.  Seeded with the per-task greedy incumbent so
+        pruning bites immediately; candidates are explored best-node-
+        objective-first.
+        """
+        tasks = dag.topological_order()
+        if not tasks:
+            return
+        index_of = {t: i for i, t in enumerate(tasks)}
+        # Edges as (src_idx, dst_idx, out_gb); egress composes with the
+        # $ objective only (chain DP does the same).
+        charge_egress = minimize is OptimizeTarget.COST
+        edges = []
+        if charge_egress:
+            for u, v in dag.graph.edges:
+                out_gb = getattr(u, 'estimated_output_gb', None) or 0.0
+                if out_gb > 0:
+                    edges.append((index_of[u], index_of[v], out_gb))
+        in_edges: List[List[Tuple[int, float]]] = [[] for _ in tasks]
+        for src, dst, gb in edges:
+            in_edges[dst].append((src, gb))
+
+        # Per task: candidates sorted by node objective (ascending).
+        cands: List[List[Tuple[resources_lib.Resources, float]]] = []
+        for t in tasks:
+            scored = [(c, cls._objective(minimize, t, c, cost, time_s,
+                                         hourly))
+                      for c, cost, time_s, hourly in
+                      cls._candidates_with_metrics(t, blocked_resources)]
+            scored.sort(key=lambda x: x[1])
+            cands.append(scored)
+        # Admissible remaining-cost bound: best node objective per
+        # not-yet-assigned suffix (egress is non-negative).
+        suffix_min = [0.0] * (len(tasks) + 1)
+        for i in range(len(tasks) - 1, -1, -1):
+            suffix_min[i] = suffix_min[i + 1] + cands[i][0][1]
+
+        # Greedy incumbent (the previous fallback behavior).
+        best_assign = [0] * len(tasks)
+        best_obj = 0.0
+        for i in range(len(tasks)):
+            best_obj += cands[i][0][1]
+            for src, gb in in_edges[i]:
+                best_obj += _egress_cost(cands[src][best_assign[src]][0],
+                                         cands[i][0][0], gb)
+
+        assign = [0] * len(tasks)
+        expansions = 0
+
+        def dfs(i: int, partial: float) -> None:
+            nonlocal best_obj, best_assign, expansions
+            if expansions > cls._BNB_MAX_EXPANSIONS:
+                return
+            if i == len(tasks):
+                if partial < best_obj:
+                    best_obj = partial
+                    best_assign = list(assign)
+                return
+            for j, (cand, node_obj) in enumerate(cands[i]):
+                expansions += 1
+                obj = partial + node_obj
+                for src, gb in in_edges[i]:
+                    obj += _egress_cost(cands[src][assign[src]][0], cand,
+                                        gb)
+                if obj + suffix_min[i + 1] >= best_obj:
+                    # Candidates are node-objective-sorted, but egress
+                    # varies per candidate — later ones can still win,
+                    # so prune this branch only, not the whole level.
+                    continue
+                assign[i] = j
+                dfs(i + 1, obj)
+            assign[i] = 0
+
+        dfs(0, 0.0)
+        for i, t in enumerate(tasks):
+            t.best_resources = cands[i][best_assign[i]][0]
 
     # ----- reporting ---------------------------------------------------------
     @classmethod

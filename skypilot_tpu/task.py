@@ -61,6 +61,10 @@ class Task:
         # Filled by the optimizer.
         self.best_resources: Optional[resources_lib.Resources] = None
         self.estimated_runtime_s: Optional[float] = None
+        # GB this task emits to each downstream task; the optimizer
+        # charges it as an egress edge cost (reference egress model:
+        # sky/optimizer.py:75-105).
+        self.estimated_output_gb: Optional[float] = None
         self._validate()
 
     # ----- validation --------------------------------------------------------

@@ -40,7 +40,7 @@ def test_event_loop_runs_roster(tmp_home, monkeypatch):
     loop = events_lib.EventLoop(
         autostop_lib.ClusterIdentity(None, None, None, None), time.time())
     names = [n for n, _ in loop.events]
-    assert names == ['autostop', 'log-gc']
+    assert names == ['autostop', 'log-gc', 'log-ship']
     fired = []
     loop.events.append(('probe', lambda: fired.append(1)))
     loop.events.append(('boom', lambda: 1 / 0))   # isolated failure

@@ -204,3 +204,109 @@ def test_local_no_spot(enable_all_clouds):
     local = clouds.get_cloud('local')
     r = Resources.from_yaml_config({'infra': 'local', 'use_spot': True})
     assert local.get_feasible_resources(r) == []
+
+
+# ----- general (non-chain) DAGs: exact branch-and-bound ---------------------
+
+
+def test_optimize_diamond_dag_colocates_for_egress(enable_all_clouds):
+    """Egress-dominated diamond (a -> b, a -> c; b,c -> d): the exact
+    general-DAG search must co-locate the fan-out with its producer when
+    moving the data costs more than the cheaper-region price delta
+    (the greedy fallback this replaces placed each task in its own
+    cheapest region, eating the egress; ref ILP: sky/optimizer.py:490).
+    """
+    a = _mk_task('produce', acc='tpu-v5e-8', infra='gcp/europe-west4')
+    b = _mk_task('branch1', acc='tpu-v5e-8', infra='gcp')
+    c = _mk_task('branch2', acc='tpu-v5e-8', infra='gcp')
+    d = _mk_task('join', acc='tpu-v5e-8', infra='gcp')
+    for t in (a, b, c, d):
+        t.estimated_runtime_s = 3600.0
+    # 10 TB out of every task: cross-region egress ($0.01/GB -> $100)
+    # dwarfs any hourly price delta between regions.
+    for t in (a, b, c):
+        t.estimated_output_gb = 10_000.0
+    dag = Dag()
+    for t in (a, b, c, d):
+        dag.add(t)
+    dag.add_edge(a, b)
+    dag.add_edge(a, c)
+    dag.add_edge(b, d)
+    dag.add_edge(c, d)
+    assert not dag.is_chain()
+    Optimizer.optimize(dag, quiet=True)
+    regions = {t.best_resources.region for t in (a, b, c, d)}
+    assert regions == {'europe-west4'}
+
+
+def test_optimize_general_dag_matches_brute_force(enable_all_clouds,
+                                                  monkeypatch):
+    """Property test: on random <=6-node DAGs with synthetic candidate
+    sets, branch-and-bound finds exactly the brute-force optimum
+    (reference shape: tests/test_optimizer_random_dag.py)."""
+    import itertools
+    import random
+
+    from skypilot_tpu import optimizer as opt_lib
+
+    rnd = random.Random(7)
+    regions = ['us-central1', 'us-west4', 'europe-west4', 'asia-east1']
+
+    for trial in range(25):
+        n_tasks = rnd.randint(2, 6)
+        tasks = []
+        for i in range(n_tasks):
+            t = Task(f't{i}', run='x')
+            t.estimated_output_gb = rnd.choice([0.0, 500.0, 5000.0])
+            tasks.append(t)
+        dag = Dag()
+        for t in tasks:
+            dag.add(t)
+        for i in range(n_tasks):
+            for j in range(i + 1, n_tasks):
+                if rnd.random() < 0.4:
+                    dag.add_edge(tasks[i], tasks[j])
+
+        # Synthetic candidates: 2-4 per task, random region + cost.
+        cand_map = {}
+        for t in tasks:
+            cands = []
+            for _ in range(rnd.randint(2, 4)):
+                r = Resources.from_yaml_config(
+                    {'infra': f'gcp/{rnd.choice(regions)}'})
+                cost = rnd.uniform(1.0, 60.0)
+                cands.append((r, cost, 3600.0, cost))
+            cand_map[id(t)] = cands
+
+        monkeypatch.setattr(
+            opt_lib.Optimizer, '_candidates_with_metrics',
+            classmethod(lambda cls, task, blocked: cand_map[id(task)]))
+
+        order = dag.topological_order()
+        idx = {t: i for i, t in enumerate(order)}
+        edges = [(idx[u], idx[v], u.estimated_output_gb or 0.0)
+                 for u, v in dag.graph.edges]
+
+        def total(assign, order=order, edges=edges, cand_map=cand_map):
+            s = sum(cand_map[id(order[i])][assign[i]][1]
+                    for i in range(len(order)))
+            for src, dst, gb in edges:
+                s += opt_lib._egress_cost(
+                    cand_map[id(order[src])][assign[src]][0],
+                    cand_map[id(order[dst])][assign[dst]][0], gb)
+            return s
+
+        want = min(
+            total(a) for a in itertools.product(
+                *[range(len(cand_map[id(t)])) for t in order]))
+
+        Optimizer.optimize(dag, quiet=True)
+        got_assign = []
+        for t in order:
+            matches = [j for j, (c, *_rest) in enumerate(cand_map[id(t)])
+                       if c is t.best_resources]
+            assert matches, f'trial {trial}: unknown placement'
+            got_assign.append(matches[0])
+        got = total(got_assign)
+        assert abs(got - want) < 1e-9, (
+            f'trial {trial}: bnb {got} != brute force {want}')

@@ -131,3 +131,64 @@ def test_agent_ships_on_job_completion(tmp_home, enable_all_clouds,
     assert 'shipped-line' in shipped.read_text()
     assert f'job-{job_id}' in str(shipped)
     assert 'shipc' in str(shipped)
+
+
+# ----- streaming/incremental shipping ---------------------------------------
+def test_ship_incremental_offsets(tmp_home, monkeypatch):
+    """Offset-tracked file-sink ship: only new bytes move per tick, and
+    an unchanged tick is a no-op (no duplication)."""
+    from skypilot_tpu import logs as logs_lib
+    sink = tmp_home / 'sink'
+    monkeypatch.setenv('SKYTPU_LOG_STORE', 'file')
+    monkeypatch.setenv('SKYTPU_LOG_PATH', str(sink))
+    log_root = tmp_home / 'jobs' / 'job-5'
+    log_root.mkdir(parents=True)
+    log = log_root / 'run-0.log'
+    log.write_text('line-1\n')
+    dst = logs_lib.ship_incremental('c', 5, str(log_root))
+    shipped = sink / 'c' / 'job-5' / 'run-0.log'
+    assert dst and shipped.read_text() == 'line-1\n'
+    # Append; next tick ships only the delta.
+    with open(log, 'a', encoding='utf-8') as f:
+        f.write('line-2\n')
+    logs_lib.ship_incremental('c', 5, str(log_root))
+    assert shipped.read_text() == 'line-1\nline-2\n'
+    # Unchanged tick: no duplication.
+    logs_lib.ship_incremental('c', 5, str(log_root))
+    assert shipped.read_text() == 'line-1\nline-2\n'
+    # Offsets live OUTSIDE the log dir (never shipped).
+    assert not list(log_root.glob('.ship*'))
+    assert (tmp_home / 'jobs' / '.ship-offsets-5.json').exists()
+
+
+def test_agent_ships_partial_logs_of_running_job(tmp_home,
+                                                 enable_all_clouds,
+                                                 monkeypatch):
+    """E2e for the preemption case: a RUNNING job's partial logs reach
+    the sink BEFORE the job finishes (a killed host would lose them
+    under ship-on-completion only)."""
+    sink = tmp_home / 'sink'
+    monkeypatch.setenv('SKYTPU_LOG_STORE', 'file')
+    monkeypatch.setenv('SKYTPU_LOG_PATH', str(sink))
+    monkeypatch.setenv('SKYTPU_AGENT_EVENT_INTERVAL', '0.3')
+    from skypilot_tpu import core, execution
+    from skypilot_tpu.resources import Resources
+    from skypilot_tpu.task import Task
+    task = Task('partial', run='echo early-line; sleep 120')
+    task.set_resources(Resources.from_yaml_config({'infra': 'local'}))
+    job_id, _ = execution.launch(task, 'partialc', detach_run=True)
+    try:
+        deadline = time.time() + 30
+        content = ''
+        while time.time() < deadline:
+            hits = list(sink.rglob('run-0.log'))
+            if hits:
+                content = hits[0].read_text()
+                if 'early-line' in content:
+                    break
+            time.sleep(0.2)
+        assert 'early-line' in content, (
+            f'partial logs never shipped (saw {content!r})')
+    finally:
+        core.cancel('partialc', job_id)
+        core.down('partialc')
