@@ -106,12 +106,38 @@ async def _drain_middleware(request, handler):
     with 503 (clients retry against the replacement instance) while
     reads, request polls, and cancels keep working so in-flight work can
     finish and be observed."""
-    if request.app.get('draining') and request.method == 'POST' and \
+    if request.method == 'POST' and \
             not request.path.endswith('/cancel') and \
-            request.path != '/api/drain':
+            request.path != '/api/drain' and \
+            await _is_draining(request.app):
         return web.json_response(
             {'error': 'server is draining; retry shortly'}, status=503)
     return await handler(request)
+
+
+_DRAIN_FLAG_TTL_S = 1.0
+
+
+async def _is_draining(app) -> bool:
+    """Local flag OR the shared server_flags row — a drain posted to any
+    worker of a multi-worker deployment must gate ALL of them.  The DB
+    read runs off-loop (sqlite can block behind a writer's transaction
+    for seconds — freezing the event loop would stall exactly the reads
+    draining promises to keep serving) and is TTL-cached."""
+    if app.get('draining'):
+        return True
+    if not app.get('multi_worker'):
+        return False
+    import time as time_lib
+    now = time_lib.monotonic()
+    cached = app.get('_drain_flag_cache')
+    if cached is not None and now - cached[0] < _DRAIN_FLAG_TTL_S:
+        return cached[1]
+    from skypilot_tpu.server import requests_db
+    value = await asyncio.get_event_loop().run_in_executor(
+        None, lambda: requests_db.get_flag('draining') == '1')
+    app['_drain_flag_cache'] = (now, value)
+    return value
 
 
 @web.middleware
@@ -170,13 +196,20 @@ def make_app() -> web.Application:
         from skypilot_tpu.serve import controller as serve_controller
         loop = asyncio.get_event_loop()
         await loop.run_in_executor(None, executor.recover)
-        await loop.run_in_executor(
-            None, jobs_controller.maybe_start_controllers)
-        await loop.run_in_executor(
-            None, serve_controller.maybe_start_controllers)
+        # Controller re-adoption and background daemons run in ONE
+        # worker (index 0): two workers both re-adopting the same
+        # unfinished jobs/serve controllers would double-drive them.
+        # Fresh controllers still start in whichever worker accepts the
+        # request — per-job/per-service threads are process-local.
+        if app.get('worker_index', 0) == 0:
+            await loop.run_in_executor(
+                None, jobs_controller.maybe_start_controllers)
+            await loop.run_in_executor(
+                None, serve_controller.maybe_start_controllers)
         # Background daemons: requests GC, cloud-truth status refresh,
         # controller liveness.  SKYTPU_DAEMONS=0 disables (tests).
-        if os.environ.get('SKYTPU_DAEMONS', '1') != '0':
+        if os.environ.get('SKYTPU_DAEMONS', '1') != '0' and \
+                app.get('worker_index', 0) == 0:
             from skypilot_tpu.server import daemons as daemons_lib
             app['daemons'] = daemons_lib.DaemonSet(
                 daemons_lib.default_daemons())
@@ -187,7 +220,8 @@ def make_app() -> web.Application:
     # ----- health / meta -----------------------------------------------------
     async def health(request):
         return web.json_response({
-            'status': 'draining' if app['draining'] else 'healthy',
+            'status': 'draining' if await _is_draining(app)
+                      else 'healthy',
             'api_version': API_VERSION,
             'min_compatible_api_version': MIN_COMPATIBLE_API_VERSION,
         })
@@ -204,8 +238,14 @@ def make_app() -> web.Application:
     async def drain(request):
         """Begin graceful shutdown: refuse new mutations, keep serving
         reads; in-flight worker processes run to completion (the
-        process-level wait happens in on_shutdown / executor.drain)."""
+        process-level wait happens in on_shutdown / executor.drain).
+        Multi-worker: the flag is written to the shared DB so every
+        sibling worker drains too, whichever one served this POST."""
         app['draining'] = True
+        if app.get('multi_worker'):
+            from skypilot_tpu.server import requests_db
+            await asyncio.get_event_loop().run_in_executor(
+                None, lambda: requests_db.set_flag('draining', '1'))
         return web.json_response({'draining': True})
 
     async def metrics_route(request):
@@ -668,13 +708,14 @@ def make_app() -> web.Application:
     return app
 
 
-def main() -> None:
-    import argparse
-    parser = argparse.ArgumentParser()
-    parser.add_argument('--port', type=int, default=8700)
-    parser.add_argument('--host', default='127.0.0.1')
-    args = parser.parse_args()
+def _serve_one(host: str, port: int, worker_index: int,
+               n_workers: int) -> None:
+    """One server process: the whole app on a SO_REUSEPORT socket (the
+    kernel load-balances accepts across workers; parity:
+    sky/server/uvicorn.py:86 multi-worker serving)."""
     app = make_app()
+    app['worker_index'] = worker_index
+    app['multi_worker'] = n_workers > 1
 
     async def on_shutdown(app):
         # SIGTERM/SIGINT → aiohttp shutdown: flip to draining and wait
@@ -687,9 +728,72 @@ def main() -> None:
             logger.warning('drain timed out; terminating workers')
 
     app.on_shutdown.append(on_shutdown)
-    web.run_app(app, host=args.host, port=args.port,
+    web.run_app(app, host=host, port=port,
+                reuse_port=(n_workers > 1) or None,
                 print=lambda *a: logger.info(
-                    f'API server on {args.host}:{args.port}'))
+                    f'API server worker {worker_index}/{n_workers} '
+                    f'on {host}:{port}'))
+
+
+def main() -> None:
+    import argparse
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--port', type=int, default=8700)
+    parser.add_argument('--host', default='127.0.0.1')
+    parser.add_argument(
+        '--workers', type=int,
+        default=int(os.environ.get('SKYTPU_SERVER_WORKERS', '1')),
+        help='server processes sharing the port via SO_REUSEPORT; the '
+        'requests DB is the shared queue (claims prevent double '
+        'dispatch), worker 0 owns controller re-adoption and daemons')
+    args = parser.parse_args()
+    if args.workers <= 1:
+        _serve_one(args.host, args.port, 0, 1)
+        return
+    import multiprocessing
+    import signal as signal_lib
+    import time as time_lib
+    # A fresh deployment is not draining; clear any flag a previous
+    # generation's drain left in the shared DB.  Done ONCE here, before
+    # any worker exists — a per-worker clear would let a late-booting
+    # worker erase a drain posted to an already-serving sibling.
+    from skypilot_tpu.server import requests_db
+    requests_db.set_flag('draining', '0')
+    ctx = multiprocessing.get_context('spawn')
+
+    def spawn(i: int):
+        p = ctx.Process(target=_serve_one,
+                        args=(args.host, args.port, i, args.workers),
+                        name=f'skytpu-api-worker-{i}')
+        p.start()
+        return p
+
+    procs = [spawn(i) for i in range(args.workers)]
+    stopping = {'flag': False}
+
+    def forward(signum, _frame):
+        stopping['flag'] = True
+        for p in procs:
+            if p.pid and p.is_alive():
+                os.kill(p.pid, signum)
+
+    signal_lib.signal(signal_lib.SIGTERM, forward)
+    signal_lib.signal(signal_lib.SIGINT, forward)
+    # Supervise: a dead worker is respawned (worker 0 exclusively owns
+    # daemons + controller re-adoption — its silent death would disable
+    # them for the whole deployment while /health still said healthy).
+    while True:
+        time_lib.sleep(1.0)
+        if stopping['flag']:
+            break
+        for i, p in enumerate(procs):
+            if not p.is_alive():
+                logger.warning(
+                    f'API worker {i} died (exit {p.exitcode}); '
+                    f'respawning')
+                procs[i] = spawn(i)
+    for p in procs:
+        p.join()
 
 
 if __name__ == '__main__':

@@ -120,10 +120,16 @@ class RequestExecutor:
     # ----- LONG: per-request worker process ----------------------------------
     def submit_process(self, name: str, body: Dict[str, Any]) -> str:
         """Run a named handler (server/handlers.py) in its own process."""
+        import os
         from skypilot_tpu.server import handlers
         assert name in handlers.HANDLERS, name
         request_id = requests_db.create(name, body, 'long')
-        self._dispatch(request_id, name, body)
+        # Claim before dispatch: a sibling worker's concurrent startup
+        # recovery must not also dispatch this fresh PENDING row.  If
+        # the sibling's recovery won the CAS first, IT dispatches — a
+        # second dispatch here would run the handler twice.
+        if requests_db.try_claim(request_id, os.getpid()):
+            self._dispatch(request_id, name, body)
         return request_id
 
     def _dispatch(self, request_id: str, name: str,
@@ -227,6 +233,12 @@ class RequestExecutor:
                 continue
             # PENDING
             if rec['name'] in handlers.HANDLERS:
+                # Multi-worker: N servers run recovery concurrently over
+                # the shared DB — the claim CAS picks exactly one
+                # dispatcher per row (and skips rows a live sibling
+                # already owns).
+                if not requests_db.try_claim(rid, os.getpid()):
+                    continue
                 logger.info(f're-adopting queued request {rid} '
                             f'({rec["name"]})')
                 self._dispatch(rid, rec['name'], rec['body'])

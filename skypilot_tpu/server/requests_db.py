@@ -49,6 +49,18 @@ _DDL = [
     # Worker-process pid (NULL for thread-executed SHORT requests);
     # lets /requests/{id}/cancel address the right process.
     'ALTER TABLE requests ADD COLUMN pid INTEGER',
+    # Which SERVER process dispatched this request (multi-worker: the
+    # requests DB is the shared queue; claims stop two workers from
+    # both dispatching one PENDING row on startup recovery), and when
+    # it claimed (pid-recycling guard: a process that started after
+    # the claim cannot be the claimer).
+    'ALTER TABLE requests ADD COLUMN claim_pid INTEGER',
+    'ALTER TABLE requests ADD COLUMN claim_at REAL',
+    # Server-wide flags shared by every worker process (e.g. draining).
+    """CREATE TABLE IF NOT EXISTS server_flags (
+        key TEXT PRIMARY KEY,
+        value TEXT
+    )""",
 ]
 
 
@@ -97,6 +109,81 @@ def set_status(request_id: str, status: RequestStatus,
                    RequestStatus.CANCELLED.value])
     db_utils.execute(_ensure(), f'UPDATE requests SET {", ".join(sets)} '
                      + where, tuple(params))
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:   # exists but not ours
+        return True
+    except TypeError:
+        return False
+
+
+def _pid_start_time(pid: int) -> Optional[float]:
+    """Unix start time of `pid` (Linux /proc), or None if unknown."""
+    try:
+        with open(f'/proc/{pid}/stat', 'r', encoding='utf-8') as f:
+            fields = f.read().rsplit(')', 1)[1].split()
+        start_ticks = int(fields[19])
+        with open('/proc/uptime', 'r', encoding='utf-8') as f:
+            uptime = float(f.read().split()[0])
+        try:
+            hz = float(os.sysconf('SC_CLK_TCK'))
+        except (ValueError, OSError):
+            hz = 100.0
+        return time.time() - uptime + start_ticks / hz
+    except (OSError, IndexError, ValueError):
+        return None
+
+
+def try_claim(request_id: str, pid: int) -> bool:
+    """Claim a PENDING request for dispatch by server process `pid`.
+
+    CAS on the previous claim value (NULL-safe `IS ?`): a claim held by
+    a live claimer is respected; a dead claimer's row is stealable —
+    that is what lets N workers run recovery concurrently without
+    double-dispatching (the one write wins, rowcount tells the loser).
+    A pid that started AFTER the claim was made cannot be the claimer
+    (pid recycling, e.g. post-reboot) — such rows are stealable too,
+    or a PENDING row could hang forever behind an unrelated process.
+    """
+    path = _ensure()
+    row = db_utils.query_one(
+        path, 'SELECT claim_pid, claim_at, status FROM requests '
+        'WHERE request_id=?', (request_id,))
+    if row is None or row['status'] != RequestStatus.PENDING.value:
+        return False
+    old = row['claim_pid']
+    if old is not None and old != pid and _pid_alive(old):
+        started = _pid_start_time(old)
+        claimed_at = row['claim_at']
+        recycled = (started is not None and claimed_at is not None and
+                    started > claimed_at + 5.0)    # 5s clock slack
+        if not recycled:
+            return False
+    return db_utils.execute_rowcount(
+        path, 'UPDATE requests SET claim_pid=?, claim_at=? '
+        'WHERE request_id=? AND claim_pid IS ? AND status=?',
+        (pid, time.time(), request_id, old,
+         RequestStatus.PENDING.value)) == 1
+
+
+def set_flag(key: str, value: str) -> None:
+    """Server-wide flag, visible to every worker process."""
+    db_utils.execute(
+        _ensure(), 'INSERT INTO server_flags (key, value) VALUES (?,?) '
+        'ON CONFLICT(key) DO UPDATE SET value=excluded.value',
+        (key, value))
+
+
+def get_flag(key: str) -> Optional[str]:
+    row = db_utils.query_one(
+        _ensure(), 'SELECT value FROM server_flags WHERE key=?', (key,))
+    return row['value'] if row else None
 
 
 def get(request_id: str) -> Optional[Dict[str, Any]]:

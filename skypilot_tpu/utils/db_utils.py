@@ -48,6 +48,13 @@ def execute(path: str, sql: str, params: Tuple = ()) -> None:
         conn.execute(sql, params)
 
 
+def execute_rowcount(path: str, sql: str, params: Tuple = ()) -> int:
+    """Execute and return the affected-row count — the primitive for
+    compare-and-swap claims (UPDATE ... WHERE <expected old value>)."""
+    with transaction(path) as conn:
+        return conn.execute(sql, params).rowcount
+
+
 def query(path: str, sql: str, params: Tuple = ()) -> List[sqlite3.Row]:
     return _connect(path).execute(sql, params).fetchall()
 

@@ -1,0 +1,209 @@
+"""Chaos tier: adversarial network/process failures against the API
+server (ref shape: tests/chaos/chaos_proxy.py — a TCP proxy that severs
+client connections mid-request).
+
+Two failure classes the durable-requests design must survive:
+- the client's TCP connection dies after the server received the
+  request (the response is lost): the request must still execute
+  server-side, and the client must be able to find and resume it from
+  the requests DB;
+- the API server process is SIGKILLed while a request is RUNNING in a
+  worker process: on restart, executor.recover() must adopt the live
+  orphan worker and the request must complete with its result.
+"""
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+import requests as requests_lib
+
+
+class SeveringProxy(threading.Thread):
+    """One-shot TCP proxy: forwards the client's request upstream,
+    reads the upstream response, then closes the client socket without
+    relaying a byte — the network died mid-request."""
+
+    def __init__(self, upstream_port: int) -> None:
+        super().__init__(daemon=True)
+        self.upstream_port = upstream_port
+        self.sock = socket.socket()
+        self.sock.bind(('127.0.0.1', 0))
+        self.sock.listen(1)
+        self.port = self.sock.getsockname()[1]
+        self.upstream_got_request = threading.Event()
+
+    def run(self) -> None:
+        client, _ = self.sock.accept()
+        try:
+            data = b''
+            client.settimeout(10)
+            while b'\r\n\r\n' not in data:
+                data += client.recv(65536)
+            head, _, body = data.partition(b'\r\n\r\n')
+            length = 0
+            for line in head.split(b'\r\n'):
+                if line.lower().startswith(b'content-length:'):
+                    length = int(line.split(b':')[1])
+            while len(body) < length:
+                body += client.recv(65536)
+            up = socket.create_connection(('127.0.0.1',
+                                           self.upstream_port))
+            up.sendall(head + b'\r\n\r\n' + body)
+            # Wait for the server to answer — PROOF it processed the
+            # request — then drop both sides on the floor.
+            up.settimeout(30)
+            assert up.recv(1)
+            self.upstream_got_request.set()
+            up.close()
+        finally:
+            client.close()
+
+
+def _server_env(home, agent_pid_file):
+    env = dict(os.environ)
+    env.update({
+        'HOME': str(home),
+        'SKYTPU_GLOBAL_CONFIG': str(home / '.skytpu' / 'config.yaml'),
+        'SKYTPU_PROJECT_CONFIG': str(home / '.skytpu.yaml'),
+        'SKYTPU_ENABLED_CLOUDS': 'local',
+        'SKYTPU_DAEMONS': '0',
+        'SKYTPU_AGENT_PID_FILE': str(agent_pid_file),
+    })
+    return env
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(('127.0.0.1', 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _start_server(port, env):
+    proc = subprocess.Popen(
+        [sys.executable, '-m', 'skypilot_tpu.server.app', '--port',
+         str(port)],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        try:
+            r = requests_lib.get(
+                f'http://127.0.0.1:{port}/api/health', timeout=1)
+            if r.ok:
+                return proc
+        except requests_lib.ConnectionError:
+            pass
+        time.sleep(0.1)
+    proc.kill()
+    raise RuntimeError('API server never became healthy')
+
+
+@pytest.fixture
+def chaos_server(tmp_path):
+    home = tmp_path / 'home'
+    home.mkdir()
+    pid_file = tmp_path / 'agent-pids.txt'
+    pid_file.touch()
+    env = _server_env(home, pid_file)
+    port = _free_port()
+    proc = _start_server(port, env)
+    yield {'port': port, 'proc': proc, 'env': env, 'home': home}
+    for p in (proc,):
+        if p.poll() is None:
+            p.kill()
+    # Reap agents this server's launches spawned.
+    for line in pid_file.read_text().splitlines():
+        try:
+            os.kill(int(line), signal.SIGKILL)
+        except (ValueError, ProcessLookupError, PermissionError):
+            pass
+
+
+def _launch_body(run='echo chaos-done', cluster='chaosc'):
+    return {
+        'task': {'name': 'chaos', 'run': run,
+                 'resources': {'infra': 'local'}},
+        'cluster_name': cluster,
+    }
+
+
+def test_severed_connection_request_survives(chaos_server):
+    """Connection dies after the server accepted the launch: the launch
+    still runs to completion server-side, and the client recovers the
+    request id from GET /requests and resumes polling it."""
+    port = chaos_server['port']
+    proxy = SeveringProxy(port)
+    proxy.start()
+    with pytest.raises(requests_lib.RequestException):
+        requests_lib.post(f'http://127.0.0.1:{proxy.port}/launch',
+                          json=_launch_body(), timeout=30)
+    assert proxy.upstream_got_request.wait(10), (
+        'server never processed the proxied request')
+    # Resume: find our request in the durable queue by name.
+    recs = requests_lib.get(f'http://127.0.0.1:{port}/requests',
+                            timeout=10).json()
+    launches = [r for r in recs if r['name'] == 'launch']
+    assert launches, 'severed launch request not in the requests DB'
+    rid = launches[0]['request_id']
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        rec = requests_lib.get(
+            f'http://127.0.0.1:{port}/requests/{rid}',
+            timeout=10).json()
+        if rec['status'] in ('SUCCEEDED', 'FAILED', 'CANCELLED'):
+            break
+        time.sleep(0.3)
+    assert rec['status'] == 'SUCCEEDED', rec.get('error')
+    # The cluster the severed request launched is really there.
+    sts = requests_lib.get(f'http://127.0.0.1:{port}/status',
+                           timeout=10).json()
+    assert any(c['name'] == 'chaosc' for c in sts)
+
+
+def test_server_killed_mid_launch_worker_adopted(chaos_server):
+    """SIGKILL the API server while a launch runs in a worker process;
+    the restarted server adopts the live orphan worker and the request
+    completes with its result (executor.recover)."""
+    port = chaos_server['port']
+    env = chaos_server['env']
+    rid = requests_lib.post(
+        f'http://127.0.0.1:{port}/launch',
+        json=_launch_body(run='sleep 3 && echo adopted-done',
+                          cluster='adoptc'),
+        timeout=30).json()['request_id']
+    # Wait until the request is RUNNING (worker spawned), then murder
+    # the server before the worker finishes.
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        rec = requests_lib.get(
+            f'http://127.0.0.1:{port}/requests/{rid}',
+            timeout=10).json()
+        if rec['status'] == 'RUNNING' and rec.get('pid'):
+            break
+        time.sleep(0.1)
+    assert rec['status'] == 'RUNNING', rec
+    worker_pid = rec['pid']
+    chaos_server['proc'].send_signal(signal.SIGKILL)
+    chaos_server['proc'].wait(timeout=10)
+    # The worker is an orphan but alive.
+    os.kill(worker_pid, 0)
+    # Restart on the same port; recover() must adopt the orphan.
+    chaos_server['proc'] = _start_server(port, env)
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        rec = requests_lib.get(
+            f'http://127.0.0.1:{port}/requests/{rid}',
+            timeout=10).json()
+        if rec['status'] in ('SUCCEEDED', 'FAILED', 'CANCELLED'):
+            break
+        time.sleep(0.3)
+    assert rec['status'] == 'SUCCEEDED', rec.get('error')
+    sts = requests_lib.get(f'http://127.0.0.1:{port}/status',
+                           timeout=10).json()
+    assert any(c['name'] == 'adoptc' for c in sts)

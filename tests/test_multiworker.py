@@ -1,0 +1,168 @@
+"""Multi-worker API server: N processes share one port (SO_REUSEPORT)
+and the requests DB as the queue (ref: sky/server/uvicorn.py:86).
+
+The hard property is single execution: two workers running startup
+recovery over the same durable queue must dispatch each PENDING row
+exactly once (requests_db.try_claim CAS).  Drain must gate every
+worker regardless of which one served the /api/drain POST.
+"""
+import os
+import time
+
+import pytest
+import requests as requests_lib
+
+from test_chaos import _free_port, _server_env, _start_server
+
+
+def _start_multiworker(port, env, workers=2):
+    import subprocess
+    import sys
+    proc = subprocess.Popen(
+        [sys.executable, '-m', 'skypilot_tpu.server.app', '--port',
+         str(port), '--workers', str(workers)],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+    deadline = time.time() + 45
+    while time.time() < deadline:
+        try:
+            if requests_lib.get(f'http://127.0.0.1:{port}/api/health',
+                                timeout=1).ok:
+                return proc
+        except requests_lib.ConnectionError:
+            pass
+        time.sleep(0.1)
+    proc.kill()
+    raise RuntimeError('multi-worker server never became healthy')
+
+
+@pytest.fixture
+def mw_server(tmp_path):
+    home = tmp_path / 'home'
+    home.mkdir()
+    pid_file = tmp_path / 'agent-pids.txt'
+    pid_file.touch()
+    env = _server_env(home, pid_file)
+    yield {'env': env, 'home': home, 'tmp': tmp_path,
+           'pid_file': pid_file}
+    import signal
+    for line in pid_file.read_text().splitlines():
+        try:
+            os.kill(int(line), signal.SIGKILL)
+        except (ValueError, ProcessLookupError, PermissionError):
+            pass
+
+
+def test_try_claim_cas(tmp_home):
+    """Exactly one claimer wins; a live claimer's row is not stealable,
+    a dead claimer's row is."""
+    from skypilot_tpu.server import requests_db
+    rid = requests_db.create('launch', {'x': 1})
+    me = os.getpid()
+    assert requests_db.try_claim(rid, me)
+    # Another live process (pid 1) cannot steal from a live claimer.
+    assert not requests_db.try_claim(rid, 1)
+    # A dead claimer's row IS stealable.
+    from skypilot_tpu.utils import db_utils
+    rid2 = requests_db.create('launch', {'x': 2})
+    dead = 2 ** 22 + 12345   # beyond default pid_max
+    db_utils.execute(
+        requests_db._ensure(),
+        'UPDATE requests SET claim_pid=? WHERE request_id=?',
+        (dead, rid2))
+    assert requests_db.try_claim(rid2, me)
+    # A terminal/claimed-and-running row is never claimable once it
+    # leaves PENDING.
+    requests_db.set_status(rid, requests_db.RequestStatus.SUCCEEDED)
+    assert not requests_db.try_claim(rid, me)
+
+
+def test_two_workers_recover_pending_rows_once(mw_server, tmp_path,
+                                               monkeypatch):
+    """Stage PENDING launch rows in the durable queue, then boot a
+    2-worker server: both workers run recovery concurrently, each row
+    must execute EXACTLY once."""
+    env = mw_server['env']
+    # Stage rows against the server's requests DB from this process.
+    monkeypatch.setenv('HOME', env['HOME'])
+    monkeypatch.setenv(
+        'SKYTPU_REQUESTS_DB',
+        os.path.join(env['HOME'], '.skytpu', 'requests.db'))
+    from skypilot_tpu.server import requests_db
+    markers = []
+    rids = []
+    for i in range(3):
+        marker = tmp_path / f'ran-{i}.txt'
+        markers.append(marker)
+        rids.append(requests_db.create('launch', {
+            'task': {'name': f'mw{i}',
+                     'run': f'echo ran >> {marker}',
+                     'resources': {'infra': 'local'}},
+            'cluster_name': f'mwc{i}',
+        }))
+    env = dict(env)
+    env['SKYTPU_REQUESTS_DB'] = os.environ['SKYTPU_REQUESTS_DB']
+    port = _free_port()
+    proc = _start_multiworker(port, env, workers=2)
+    try:
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            recs = {r['request_id']: r for r in requests_lib.get(
+                f'http://127.0.0.1:{port}/requests', timeout=10).json()}
+            sts = [recs.get(rid, {}).get('status') for rid in rids]
+            if all(s in ('SUCCEEDED', 'FAILED') for s in sts):
+                break
+            time.sleep(0.5)
+        assert all(s == 'SUCCEEDED' for s in sts), sts
+        # The launch request succeeds at job submission; the agent runs
+        # the job moments later — wait for every marker, then give a
+        # would-be duplicate execution time to land before counting.
+        deadline = time.time() + 60
+        while time.time() < deadline and not all(
+                m.exists() for m in markers):
+            time.sleep(0.2)
+        time.sleep(3)
+        for marker in markers:
+            assert marker.exists(), f'{marker} never ran'
+            lines = marker.read_text().splitlines()
+            assert lines == ['ran'], (
+                f'{marker}: executed {len(lines)} times (want exactly 1)')
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=30)
+        except Exception:  # pylint: disable=broad-except
+            proc.kill()
+
+
+def test_drain_gates_every_worker(mw_server):
+    """POST /api/drain lands on ONE worker; every worker must then 503
+    new mutations (shared flag in the requests DB)."""
+    env = mw_server['env']
+    port = _free_port()
+    proc = _start_multiworker(port, env, workers=2)
+    try:
+        r = requests_lib.post(f'http://127.0.0.1:{port}/api/drain',
+                              timeout=10)
+        assert r.ok
+        # Many attempts so the kernel's SO_REUSEPORT hashing spreads
+        # them over both workers: every single one must be refused.
+        for _ in range(10):
+            r = requests_lib.post(
+                f'http://127.0.0.1:{port}/launch',
+                json={'task': {'name': 'nope', 'run': 'echo no',
+                               'resources': {'infra': 'local'}},
+                      'cluster_name': 'nopec'},
+                timeout=10)
+            assert r.status_code == 503, r.text
+        # Reads still work while draining.
+        assert requests_lib.get(
+            f'http://127.0.0.1:{port}/requests', timeout=10).ok
+        assert requests_lib.get(
+            f'http://127.0.0.1:{port}/api/health',
+            timeout=10).json()['status'] == 'draining'
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=30)
+        except Exception:  # pylint: disable=broad-except
+            proc.kill()

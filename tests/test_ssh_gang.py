@@ -1,0 +1,135 @@
+"""Multi-node gang execution over the SSH runner path.
+
+The product's core promise is a gang of one process per host with the
+rank/coordinator env contract (ref env: task_codegen.py:583-623).  The
+local cloud only exercises LocalProcessRunner on one host; these tests
+drive GangJob through SSHCommandRunner.popen against a loopback `ssh`
+shim (no sshd in this environment): the shim consumes the ssh option
+argv exactly as the real client would and runs the remote command
+locally, so the whole SSH runner path — argv construction, env export
+via the remote bash -c wrapper, log pumps, process-group kill — is the
+code under test.
+"""
+import os
+import stat
+import time
+
+import pytest
+
+from skypilot_tpu.agent import gang as gang_lib
+from skypilot_tpu.agent import job_queue
+
+
+@pytest.fixture
+def ssh_shim(tmp_path, monkeypatch):
+    """Puts a fake `ssh` first on PATH; logs each target host."""
+    shim_dir = tmp_path / 'shim'
+    shim_dir.mkdir()
+    targets = tmp_path / 'ssh-targets.log'
+    shim = shim_dir / 'ssh'
+    shim.write_text(f'''#!/usr/bin/env bash
+# Loopback stand-in for the OpenSSH client (option-compatible argv).
+args=()
+while [[ $# -gt 0 ]]; do
+  case "$1" in
+    -o|-p|-i) shift 2 ;;
+    -T|-tt) shift ;;
+    *) args+=("$1"); shift ;;
+  esac
+done
+echo "${{args[0]}}" >> {targets}
+unset 'args[0]'
+exec bash -c "${{args[*]}}"
+''')
+    shim.chmod(shim.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv('PATH',
+                       f'{shim_dir}{os.pathsep}{os.environ["PATH"]}')
+    return targets
+
+
+def _spec(run, nodes, envs=None):
+    return {
+        'run': run,
+        'nodes': nodes,
+        'chips_per_host': 4,
+        'is_local': False,
+        'ssh_user': 'skytpu',
+        'ssh_key_path': None,
+        'envs': dict(envs or {}),
+    }
+
+
+def test_two_node_gang_rank_env_over_ssh(tmp_path, ssh_shim):
+    """Each rank sees the full distributed env contract, delivered
+    through the SSH runner's remote bash -c export wrapper."""
+    out = tmp_path / 'rank-out'
+    out.mkdir()
+    run = ('echo "rank=$SKYTPU_NODE_RANK nodes=$SKYTPU_NUM_NODES '
+           'coord=$SKYTPU_COORDINATOR_ADDR chips=$SKYTPU_NUM_TPU_CHIPS '
+           'ips=$SKYTPU_NODE_IPS" > '
+           f'{out}/rank-$SKYTPU_NODE_RANK.txt')
+    spec = _spec(run, [["127.0.0.2"], ["127.0.0.3"]])
+    log_dir = str(tmp_path / 'logs')
+    statuses = []
+    rc = gang_lib.run_gang_job(
+        1, spec, log_dir, lambda s, r: statuses.append((s, r)))
+    assert rc == 0
+    assert statuses[-1][0] is job_queue.JobStatus.SUCCEEDED
+    r0 = (out / 'rank-0.txt').read_text()
+    r1 = (out / 'rank-1.txt').read_text()
+    assert 'rank=0 nodes=2' in r0 and 'rank=1 nodes=2' in r1
+    # Coordinator is the head host for BOTH ranks; chips env delivered.
+    assert 'coord=127.0.0.2:' in r0 and 'coord=127.0.0.2:' in r1
+    assert 'chips=4' in r0 and 'chips=4' in r1
+    # The node-ip roster reached both ranks (newline-separated).
+    assert '127.0.0.2' in r0 and '127.0.0.3' in r1
+    # Both hosts were reached THROUGH the ssh client path.
+    targets = ssh_shim.read_text().splitlines()
+    assert 'skytpu@127.0.0.2' in targets and 'skytpu@127.0.0.3' in targets
+    # Per-rank logs were pumped through the SSH stdout pipe.
+    assert (tmp_path / 'logs' / 'run-0.log').exists()
+    assert (tmp_path / 'logs' / 'run-1.log').exists()
+
+
+def test_gang_rank_failure_kills_peer_over_ssh(tmp_path, ssh_shim):
+    """Any rank's non-zero exit is terminal for the whole gang: the
+    surviving rank's process tree must be killed (a dead host wedges
+    the ICI mesh; peers would block in collectives forever)."""
+    marker = tmp_path / 'survivor-finished'
+    run = ('if [ "$SKYTPU_NODE_RANK" = "0" ]; then exit 3; '
+           f'else sleep 120 && touch {marker}; fi')
+    spec = _spec(run, [["127.0.0.2"], ["127.0.0.3"]])
+    statuses = []
+    t0 = time.time()
+    rc = gang_lib.run_gang_job(
+        2, spec, str(tmp_path / 'logs'),
+        lambda s, r: statuses.append((s, r)))
+    elapsed = time.time() - t0
+    assert rc == 3
+    assert statuses[-1][0] is job_queue.JobStatus.FAILED
+    assert elapsed < 30, 'gang did not fail fast on rank death'
+    assert not marker.exists()
+
+
+def test_gang_cancel_tears_down_ssh_ranks(tmp_path, ssh_shim):
+    """Cancellation kills every rank's remote process group."""
+    import threading
+    marker = tmp_path / 'ran-to-completion'
+    run = f'sleep 120 && touch {marker}'
+    spec = _spec(run, [["127.0.0.2"], ["127.0.0.3"]])
+    job = gang_lib.GangJob(3, spec, str(tmp_path / 'logs'))
+    statuses = []
+    th = threading.Thread(
+        target=lambda: gang_lib.run_gang_job(
+            3, spec, str(tmp_path / 'logs'),
+            lambda s, r: statuses.append((s, r)), job=job))
+    th.start()
+    deadline = time.time() + 15
+    while time.time() < deadline and len(job._procs) < 2:
+        time.sleep(0.1)
+    assert len(job._procs) == 2, 'ranks never started'
+    job.cancel()
+    th.join(timeout=30)
+    assert not th.is_alive()
+    assert statuses[-1][0] is job_queue.JobStatus.CANCELLED
+    assert not marker.exists()
