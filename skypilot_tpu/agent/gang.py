@@ -70,6 +70,17 @@ class GangJob:
         return [ip for node in self.spec.get('nodes', [['127.0.0.1']])
                 for ip in node]
 
+    def run_docker_bootstrap(self) -> int:
+        """Start the task container on every host (docker:<image>
+        tasks; provision/docker_utils.py).  Idempotent per host."""
+        image = self.spec.get('docker_image')
+        if not image:
+            return 0
+        from skypilot_tpu.provision import docker_utils
+        cmd = docker_utils.bootstrap_command(
+            image, self.spec.get('workdir_dest'))
+        return self._fan_out(cmd, phase='docker-init')
+
     def run_setup(self) -> int:
         setup = self.spec.get('setup')
         if not setup:
@@ -99,8 +110,18 @@ class GangJob:
             runner = self._runner_for(ip)
             workdir = self.spec.get('workdir_dest')
             full_cmd = cmd
-            if workdir and not isinstance(runner,
-                                          runner_lib.LocalProcessRunner):
+            docker_image = self.spec.get('docker_image')
+            if docker_image and phase != 'docker-init':
+                # Task phases execute INSIDE the container; env must
+                # cross the docker exec boundary (a host-side export
+                # would not), so it rides the wrapped command and the
+                # runner gets none.
+                from skypilot_tpu.provision import docker_utils
+                full_cmd = docker_utils.wrap(cmd, env=env,
+                                             workdir=workdir)
+                env = {}
+            elif workdir and not isinstance(
+                    runner, runner_lib.LocalProcessRunner):
                 full_cmd = f'cd {shlex.quote(workdir)} && {cmd}'
             procs.append(runner.popen(full_cmd, env=env,
                                       log_path=log_path))
@@ -183,7 +204,9 @@ def run_gang_job(job_id: int, spec: Dict[str, Any], log_dir: str,
     if job is None:
         job = GangJob(job_id, spec, log_dir)
     status_cb(job_queue.JobStatus.SETTING_UP, None)
-    rc = job.run_setup()
+    rc = job.run_docker_bootstrap()
+    if rc == 0:
+        rc = job.run_setup()
     if job._cancelled:  # pylint: disable=protected-access
         status_cb(job_queue.JobStatus.CANCELLED, rc)
         return rc

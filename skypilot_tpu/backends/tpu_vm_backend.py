@@ -409,6 +409,13 @@ class TpuVmBackend(backend_lib.Backend):
             'workdir_dest': (self._workdir_dest(handle)
                              if task.workdir else None),
         }
+        # docker:<image> task runtime: the gang starts a privileged
+        # container per host and runs setup/run inside it
+        # (provision/docker_utils.py; ref sky/provision/docker_utils.py).
+        from skypilot_tpu.provision import docker_utils
+        docker_image = docker_utils.image_from_resources(res.image_id)
+        if docker_image:
+            spec['docker_image'] = docker_image
         if setup_only:
             spec['setup'] = task.setup
         else:

@@ -164,15 +164,20 @@ class JobController:
         # (server-ambient) controller thread are stamped correctly.
         from skypilot_tpu import users as users_lib
         from skypilot_tpu import workspaces as workspaces_lib
-        with users_lib.override(rec.get('user_name')), \
-                workspaces_lib.override(rec.get('workspace')):
-            self._run_all_tasks(rec)
+        try:
+            with users_lib.override(rec.get('user_name')), \
+                    workspaces_lib.override(rec.get('workspace')):
+                self._run_all_tasks(rec)
+        except _ControllerStopped:
+            logger.info(f'Managed job {self.job_id}: controller stopped '
+                        f'(shutdown); job left for re-adoption')
 
     def _run_all_tasks(self, rec: dict) -> None:
         configs = rec['task_configs']
         strategy: Optional[StrategyExecutor] = None
         try:
             for idx in range(rec['task_index'], len(configs)):
+                _check_shutdown()
                 rec = state.get(self.job_id)
                 task = task_lib.Task.from_yaml_config(configs[idx])
                 cluster_name = rec['cluster_name'] or cluster_name_for_job(
@@ -247,6 +252,7 @@ class JobController:
         # over it would run two copies concurrently.
         unknown_streak = 0
         while True:
+            _check_shutdown()
             if self._cancel_requested():
                 self._finish_cancel(strategy, cluster_job_id)
                 return _TaskOutcome.CANCELLED
@@ -341,14 +347,59 @@ class JobController:
                 unknown_streak = 0
                 continue
             # RUNNING / PENDING / SETTING_UP on a healthy cluster (or a
-            # transient agent hiccup): poll again.
-            time.sleep(_poll_interval())
+            # transient agent hiccup): poll again (shutdown-interruptible).
+            _shutdown.wait(_poll_interval())
 
 
 # ----- controller manager (scheduler) ----------------------------------------
 
 _manager_lock = threading.Lock()
 _controllers: Dict[int, threading.Thread] = {}
+_shutdown = threading.Event()
+
+
+class _ControllerStopped(BaseException):
+    """Raised inside a controller by the shutdown check.  BaseException
+    on purpose: it must escape _run_all_tasks' status-writing handlers —
+    a stopped controller leaves its job exactly as-is for re-adoption
+    (maybe_start_controllers on the next server start)."""
+
+
+def _check_shutdown() -> None:
+    if _shutdown.is_set():
+        raise _ControllerStopped()
+
+
+def stop_all_controllers(timeout_s: float = 15.0) -> None:
+    """Cooperatively stop every controller thread WITHOUT any job-status
+    writes.  Server drain uses this; so do test teardowns — a controller
+    outliving its environment keeps polling and mutates whatever jobs DB
+    the new environment resolves to."""
+    with _manager_lock:
+        threads = [th for th in _controllers.values() if th.is_alive()]
+    if not threads:
+        with _manager_lock:
+            _controllers.clear()
+        return
+    _shutdown.set()
+    try:
+        deadline = time.time() + timeout_s
+        for th in threads:
+            th.join(timeout=max(0.0, deadline - time.time()))
+    finally:
+        _shutdown.clear()
+    with _manager_lock:
+        # Keep stragglers registered: a thread that outlived the join
+        # (blocked in a long provision call) resumes once _shutdown
+        # clears, and forgetting it would let maybe_start_controllers
+        # spawn a DUPLICATE controller for the same job.
+        stragglers = {jid: th for jid, th in _controllers.items()
+                      if th.is_alive()}
+        _controllers.clear()
+        _controllers.update(stragglers)
+    for jid in stragglers:
+        logger.warning(f'jobs controller {jid} did not stop within '
+                       f'{timeout_s}s; left registered')
 
 
 def _max_parallel() -> int:
@@ -359,6 +410,8 @@ def maybe_start_controllers() -> None:
     """Start controller threads for non-terminal jobs, newest-submitted
     last, up to the parallelism cap (parity:
     sky/jobs/scheduler.py:194 maybe_start_controllers)."""
+    if _shutdown.is_set():
+        return            # draining: do not resurrect controllers
     with _manager_lock:
         alive = {jid for jid, th in _controllers.items() if th.is_alive()}
         capacity = _max_parallel() - len(alive)

@@ -121,11 +121,15 @@ def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
         raise exceptions.InvalidRequestError(
             'gcp-disk volumes cannot attach to TPU slices; use storage '
             '(bucket) mounts for checkpoints/datasets on TPUs')
-    if res.image_id:
+    from skypilot_tpu.provision import docker_utils
+    if res.image_id and not docker_utils.image_from_resources(
+            res.image_id):
         raise exceptions.InvalidRequestError(
             'image_id does not apply to TPU slices; their software '
             'stack is selected by the TPU runtime version (the '
-            '`runtime_version` resources field)')
+            '`runtime_version` resources field).  `docker:<image>` IS '
+            'supported — the task runs in a privileged container on '
+            'each TPU VM host')
     client = _client()
     zone = config.zone
     existing = _cluster_nodes(client, zone, config.cluster_name)
@@ -213,7 +217,11 @@ def _run_gce_instances(config: common.ProvisionConfig,
     if config.authorized_key:
         metadata['ssh-keys'] = f'skytpu:{config.authorized_key}'
     attach_disks = sorted(config.volumes.values()) or None
-    source_image = res.image_id
+    # docker:<image> is a task RUNTIME (container on the VM), not a VM
+    # boot image — the gang executor handles it (agent/gang.py).
+    from skypilot_tpu.provision import docker_utils
+    source_image = (None if docker_utils.image_from_resources(
+        res.image_id) else res.image_id)
     disk_size_gb = int(res.disk_size)
     if attach_disks:
         # Format-if-new and mount each named disk at its mount_path on

@@ -84,6 +84,13 @@ class ServiceController:
 
     def _run_inner(self) -> None:
         while True:
+            if _shutdown.is_set():
+                # Cooperative stop (drain/tests): no status writes —
+                # the service is re-adopted by maybe_start_controllers
+                # on the next server start.
+                logger.info(f'Service {self.service_name!r}: controller '
+                            f'stopped (shutdown); left for re-adoption')
+                return
             rec = serve_state.get_service(self.service_name)
             if rec is None or rec['status'] is ServiceStatus.SHUTTING_DOWN:
                 logger.info(f'Service {self.service_name!r}: shutting '
@@ -108,7 +115,7 @@ class ServiceController:
                             f'{decision.target_num_replicas}.')
                 self.manager.scale_down(-decision.delta)
             self._update_service_status()
-            time.sleep(_tick_interval())
+            _shutdown.wait(_tick_interval())
 
     def _update_service_status(self) -> None:
         rec = serve_state.get_service(self.service_name)
@@ -133,11 +140,43 @@ class ServiceController:
 
 _manager_lock = threading.Lock()
 _controllers: Dict[str, threading.Thread] = {}
+_shutdown = threading.Event()
+
+
+def stop_all_controllers(timeout_s: float = 15.0) -> None:
+    """Cooperatively stop every service controller without status
+    writes (services stay re-adoptable); mirrors
+    jobs.controller.stop_all_controllers."""
+    with _manager_lock:
+        threads = [th for th in _controllers.values() if th.is_alive()]
+    if not threads:
+        with _manager_lock:
+            _controllers.clear()
+        return
+    _shutdown.set()
+    try:
+        deadline = time.time() + timeout_s
+        for th in threads:
+            th.join(timeout=max(0.0, deadline - time.time()))
+    finally:
+        _shutdown.clear()
+    with _manager_lock:
+        # Keep stragglers registered (see jobs.controller: forgetting a
+        # still-alive thread lets maybe_start_controllers duplicate it).
+        stragglers = {name: th for name, th in _controllers.items()
+                      if th.is_alive()}
+        _controllers.clear()
+        _controllers.update(stragglers)
+    for name in stragglers:
+        logger.warning(f'serve controller {name!r} did not stop within '
+                       f'{timeout_s}s; left registered')
 
 
 def maybe_start_controllers() -> None:
     """Start controller threads for live services (startup re-adoption +
     serve-up hook), mirroring jobs.controller.maybe_start_controllers."""
+    if _shutdown.is_set():
+        return            # draining: do not resurrect controllers
     with _manager_lock:
         for rec in serve_state.list_services():
             name = rec['name']

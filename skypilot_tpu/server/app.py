@@ -258,14 +258,33 @@ def make_app() -> web.Application:
         rec = requests_db.get(request.match_info['request_id'])
         if rec is None:
             return web.json_response({'error': 'not found'}, status=404)
+        if not _requests_visible_to(request, [rec]):
+            return web.json_response(
+                {'error': 'permission denied: not your request'},
+                status=403)
         out = dict(rec)
         out['status'] = rec['status'].value
         return web.json_response(out, dumps=lambda o: json.dumps(
             o, default=str))
 
+    def _requests_visible_to(request, records):
+        """RBAC scoping: non-admins see their own requests plus
+        unattributed ones (pre-RBAC rows, internal submissions); admins
+        and RBAC-off deployments see everything."""
+        from skypilot_tpu import users as users_lib
+        caller = request.get('auth_user') or \
+            request.headers.get(USER_HEADER)
+        with users_lib.override(caller):
+            user = users_lib.current_user()
+        if user.role == users_lib.ADMIN:
+            return records
+        return [r for r in records
+                if r.get('user') in (None, user.name)]
+
     async def list_requests(request):
         out = []
-        for rec in requests_db.list_requests():
+        for rec in _requests_visible_to(request,
+                                        requests_db.list_requests()):
             r = dict(rec)
             r['status'] = rec['status'].value
             out.append(r)
@@ -364,6 +383,7 @@ def make_app() -> web.Application:
     async def autostop(request):
         body = await _json_body(request, 'autostop')
         cluster = body['cluster_name']
+        _inject_identity(request, body)
         request_id = request.app['executor'].submit(
             'autostop', body,
             _with_identity(request, lambda: core.autostop(
@@ -466,6 +486,7 @@ def make_app() -> web.Application:
             from skypilot_tpu import jobs as jobs_lib
             return {'job_id': jobs_lib.launch(payload, name)}
 
+        _inject_identity(request, body)
         request_id = request.app['executor'].submit(
             'jobs_launch', body, _with_identity(request, work), long=False)
         return web.json_response({'request_id': request_id})
@@ -539,6 +560,7 @@ def make_app() -> web.Application:
             from skypilot_tpu import serve as serve_lib
             return serve_lib.up(task, name)
 
+        _inject_identity(request, body)
         request_id = request.app['executor'].submit(
             'serve_up', body, _with_identity(request, work), long=False)
         return web.json_response({'request_id': request_id})
@@ -553,6 +575,7 @@ def make_app() -> web.Application:
             serve_lib.down(name, purge=purge)
             return {'down': name}
 
+        _inject_identity(request, body)
         request_id = request.app['executor'].submit(
             'serve_down', body, work, long=False)
         return web.json_response({'request_id': request_id})
@@ -722,10 +745,19 @@ def _serve_one(host: str, port: int, worker_index: int,
         # for in-flight worker processes before cleanup tears them down.
         app['draining'] = True
         timeout = float(os.environ.get('SKYTPU_DRAIN_TIMEOUT', '300'))
-        drained = await asyncio.get_event_loop().run_in_executor(
+        loop = asyncio.get_event_loop()
+        drained = await loop.run_in_executor(
             None, lambda: app['executor'].drain(timeout))
         if not drained:
             logger.warning('drain timed out; terminating workers')
+        # Stop in-process jobs/serve controller threads without status
+        # writes — the next server's maybe_start_controllers re-adopts.
+        from skypilot_tpu.jobs import controller as jobs_controller
+        from skypilot_tpu.serve import controller as serve_controller
+        await loop.run_in_executor(
+            None, jobs_controller.stop_all_controllers)
+        await loop.run_in_executor(
+            None, serve_controller.stop_all_controllers)
 
     app.on_shutdown.append(on_shutdown)
     web.run_app(app, host=host, port=port,

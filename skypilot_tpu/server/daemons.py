@@ -85,11 +85,19 @@ def _controller_liveness() -> None:
     serve_controller.maybe_start_controllers()
 
 
+def _k8s_metrics_scrape() -> int:
+    from skypilot_tpu import metrics_utils
+    return metrics_utils.maybe_scrape()
+
+
 def default_daemons() -> List[Daemon]:
     return [
         Daemon('requests-gc', 3600.0, _requests_gc),
         Daemon('status-refresh', 300.0, _status_refresh),
         Daemon('controller-liveness', 60.0, _controller_liveness),
+        # Pod cpu/mem/TPU-chip gauges for /metrics (no-op without k8s;
+        # ref scrapes GPU metrics similarly, sky/metrics/utils.py:218).
+        Daemon('k8s-metrics', 60.0, _k8s_metrics_scrape),
     ]
 
 

@@ -202,8 +202,20 @@ class RequestExecutor:
         """
         import os
         from skypilot_tpu.server import handlers
+        me = os.getpid()
         for rec in requests_db.nonterminal_requests():
             rid = rec['request_id']
+            # A row claimed by a LIVE sibling server process is that
+            # sibling's business — RUNNING thread work (pid NULL) and
+            # its queued short requests would otherwise be marked
+            # FAILED here while the sibling is actively executing them
+            # (multi-worker: late-booting/respawned workers run this
+            # scan while siblings serve).
+            sibling = (rec['claim_pid'] and rec['claim_pid'] != me and
+                       requests_db.claim_is_live(rec['claim_pid'],
+                                                 rec['claim_at']))
+            if sibling:
+                continue          # the sibling supervises its own work
             if rec['status'] is RequestStatus.RUNNING:
                 pid = rec['pid']
                 alive = False
@@ -243,6 +255,8 @@ class RequestExecutor:
                             f'({rec["name"]})')
                 self._dispatch(rid, rec['name'], rec['body'])
             else:
+                # Thread-work closure died with its server process (and
+                # no live sibling owns the row).
                 requests_db.set_status(
                     rid, RequestStatus.FAILED,
                     error='server restarted before this request started; '
@@ -306,8 +320,12 @@ class RequestExecutor:
     # ----- SHORT (and consolidated controllers): thread pool -----------------
     def submit(self, name: str, body: Dict[str, Any],
                fn: Callable[[], Any], long: bool = True) -> str:
+        import os
         request_id = requests_db.create(name, body,
                                         'long' if long else 'short')
+        # Claim thread work too: a sibling worker's recovery must know a
+        # live process owns this row (it cannot see our thread).
+        requests_db.try_claim(request_id, os.getpid())
         pool = self._long if long else self._short
 
         def work():

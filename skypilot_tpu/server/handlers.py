@@ -90,3 +90,15 @@ def run_request(request_id: str, name: str, body: Dict[str, Any]) -> None:
         requests_db.set_status(
             request_id, RequestStatus.FAILED,
             error=f'{type(e).__name__}: {e}\n{traceback.format_exc()}')
+    finally:
+        # Peak RSS of this worker process: the capacity signal for
+        # sizing API hosts (ref: sky/server/requests/executor.py:570).
+        try:
+            import resource
+            import sys
+            rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+            if sys.platform == 'darwin':
+                rss //= 1024      # macOS reports bytes, Linux KB
+            requests_db.record_peak_rss(request_id, rss)
+        except Exception:  # pylint: disable=broad-except
+            pass

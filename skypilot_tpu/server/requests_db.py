@@ -56,6 +56,12 @@ _DDL = [
     # the claim cannot be the claimer).
     'ALTER TABLE requests ADD COLUMN claim_pid INTEGER',
     'ALTER TABLE requests ADD COLUMN claim_at REAL',
+    # Worker peak RSS in KB, recorded at completion (parity:
+    # sky/server/requests/executor.py:570 per-request memory
+    # accounting) — the capacity-planning signal for sizing API hosts.
+    'ALTER TABLE requests ADD COLUMN peak_rss_kb INTEGER',
+    # Submitting user (RBAC: non-admins list only their own requests).
+    'ALTER TABLE requests ADD COLUMN user TEXT',
     # Server-wide flags shared by every worker process (e.g. draining).
     """CREATE TABLE IF NOT EXISTS server_flags (
         key TEXT PRIMARY KEY,
@@ -76,9 +82,9 @@ def create(name: str, body: Dict[str, Any],
     db_utils.execute(
         _ensure(),
         'INSERT INTO requests (request_id, name, status, created_at, body, '
-        'schedule_type) VALUES (?,?,?,?,?,?)',
+        'schedule_type, user) VALUES (?,?,?,?,?,?,?)',
         (request_id, name, RequestStatus.PENDING.value, time.time(),
-         json.dumps(body), schedule_type))
+         json.dumps(body), schedule_type, body.get('_user')))
     return request_id
 
 
@@ -202,7 +208,31 @@ def get(request_id: str) -> Optional[Dict[str, Any]]:
         'result': json.loads(row['result']) if row['result'] else None,
         'error': row['error'],
         'pid': row['pid'],
+        'peak_rss_kb': row['peak_rss_kb'],
+        'user': row['user'],
+        'claim_pid': row['claim_pid'],
+        'claim_at': row['claim_at'],
     }
+
+
+def claim_is_live(claim_pid: Optional[int],
+                  claim_at: Optional[float]) -> bool:
+    """True if the claiming server process is still the claimer: alive,
+    and not a recycled pid (a process that started after the claim was
+    made cannot be the claimer)."""
+    if not claim_pid or not _pid_alive(claim_pid):
+        return False
+    started = _pid_start_time(claim_pid)
+    if started is not None and claim_at is not None and \
+            started > claim_at + 5.0:
+        return False
+    return True
+
+
+def record_peak_rss(request_id: str, kb: int) -> None:
+    db_utils.execute(
+        _ensure(), 'UPDATE requests SET peak_rss_kb=? WHERE request_id=?',
+        (kb, request_id))
 
 
 def list_requests(limit: int = 100) -> List[Dict[str, Any]]:

@@ -146,6 +146,28 @@ class FakeK8sApi:
 
             def do_GET(self):
                 path, _, query = self.path.partition('?')
+                # metrics-server API: synthetic usage for every pod
+                # (metrics_utils scrape target).
+                m = re.match(
+                    r'^/apis/metrics\.k8s\.io/v1beta1/namespaces/'
+                    r'([^/]+)/pods$', path)
+                if m:
+                    ns = m.group(1)
+                    items = []
+                    with state.lock:
+                        for key, pod in state.pods.items():
+                            if not key.startswith(f'{ns}/'):
+                                continue
+                            items.append({
+                                'metadata': dict(pod['metadata']),
+                                'containers': [{
+                                    'name': 'main',
+                                    'usage': {'cpu': '250m',
+                                              'memory': '1Gi'},
+                                }],
+                            })
+                    return self._send(200, {'kind': 'PodMetricsList',
+                                            'items': items})
                 m = re.match(r'^/api/v1/namespaces/([^/]+)/pods/([^/]+)$',
                              path)
                 if m:

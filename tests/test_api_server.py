@@ -41,6 +41,12 @@ def api_server(tmp_home, enable_all_clouds, monkeypatch):
     asyncio.run_coroutine_threadsafe(
         server_holder['server'].close(), loop).result(timeout=10)
     loop.call_soon_threadsafe(loop.stop)
+    # In-process jobs/serve controller threads must not outlive this
+    # test's $HOME (they would mutate the next test's DBs).
+    from skypilot_tpu.jobs import controller as jobs_controller
+    from skypilot_tpu.serve import controller as serve_controller
+    jobs_controller.stop_all_controllers()
+    serve_controller.stop_all_controllers()
 
 
 def _mk_local_task(run='echo api-hello'):
@@ -64,6 +70,9 @@ def test_launch_via_sdk_end_to_end(api_server):
     result = sdk.get(request_id)
     assert result['cluster_name'] == 'apie2e'
     job_id = result['job_id']
+    # Per-request memory accounting: the worker recorded its peak RSS.
+    rec = sdk._get(f'/requests/{request_id}')
+    assert rec.get('peak_rss_kb') and rec['peak_rss_kb'] > 0
     # poll queue until terminal
     deadline = time.time() + 30
     while time.time() < deadline:

@@ -62,9 +62,12 @@ def test_default_instance_type():
     assert t is not None
     vcpus, _ = gcp_catalog.get_vm_spec(t)
     assert vcpus >= 4
-    # Exact spec
+    # Exact spec: the CHEAPEST 8-vcpu/64-GB type wins (not a pinned
+    # name — the catalog carries several families at this shape).
     t = catalog.get_default_instance_type(cpus='8', memory='64')
-    assert t == 'n2-highmem-8'
+    vcpus, mem = gcp_catalog.get_vm_spec(t)
+    assert (vcpus, mem) == (8, 64)
+    assert t == 'e2-highmem-8'   # cheapest 8x64 in the bundled catalog
 
 
 def test_cpu_only_cost_uses_default_instance():

@@ -90,7 +90,9 @@ def _start_server(port, env):
         [sys.executable, '-m', 'skypilot_tpu.server.app', '--port',
          str(port)],
         env=env, stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
-    deadline = time.time() + 30
+    # Generous: under -n 4 suite contention a cold server process can
+    # take well over 30s just importing.
+    deadline = time.time() + 120
     while time.time() < deadline:
         try:
             r = requests_lib.get(

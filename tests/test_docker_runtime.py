@@ -1,0 +1,121 @@
+"""Docker task runtime (parity: sky/provision/docker_utils.py): tasks
+with `image_id: docker:<image>` run inside a privileged, host-network
+container on each host.  A PATH shim stands in for the docker CLI
+(recording every invocation; `exec` runs the command locally so the
+rank-env contract can be asserted end-to-end through the gang)."""
+import os
+import stat
+
+import pytest
+
+from skypilot_tpu.agent import gang as gang_lib
+from skypilot_tpu.agent import job_queue
+from skypilot_tpu.provision import docker_utils
+
+
+def test_image_from_resources():
+    assert docker_utils.image_from_resources('docker:python:3.11') == \
+        'python:3.11'
+    assert docker_utils.image_from_resources(
+        'projects/x/global/images/img') is None
+    assert docker_utils.image_from_resources(None) is None
+
+
+def test_bootstrap_command_shape():
+    cmd = docker_utils.bootstrap_command('myimg:latest', '/wd')
+    assert '--privileged' in cmd
+    assert '--network=host' in cmd          # JAX coordinator on host IPs
+    assert 'docker pull myimg:latest' in cmd
+    assert '-v /dev:/dev' in cmd            # TPU device nodes
+    assert '-v /wd:/wd' in cmd
+    assert 'sleep infinity' in cmd
+    # Idempotence: reuse a same-image container, replace others.
+    assert 'docker inspect' in cmd and 'docker rm -f' in cmd
+
+
+def test_wrap_env_crosses_exec_boundary():
+    cmd = docker_utils.wrap('echo $FOO', env={'FOO': 'bar'},
+                            workdir='/wd')
+    assert cmd.startswith(f'docker exec {docker_utils.CONTAINER_NAME} ')
+    # env is exported INSIDE the container command, not host-side
+    assert 'export FOO=bar' in cmd
+    assert 'cd /wd' in cmd
+
+
+@pytest.fixture
+def docker_shim(tmp_path, monkeypatch):
+    shim_dir = tmp_path / 'shim'
+    shim_dir.mkdir()
+    calls = tmp_path / 'docker-calls.log'
+    shim = shim_dir / 'docker'
+    shim.write_text(f'''#!/usr/bin/env bash
+echo "$@" >> {calls}
+case "$1" in
+  inspect) exit 1 ;;                # no container yet
+  pull|run|rm) exit 0 ;;
+  exec)
+    shift                           # container name
+    shift
+    exec "$@" ;;                    # bash -c '<cmd>' runs locally
+  *) exit 0 ;;
+esac
+''')
+    shim.chmod(shim.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv('PATH',
+                       f'{shim_dir}{os.pathsep}{os.environ["PATH"]}')
+    return calls
+
+
+def test_gang_runs_task_in_container(tmp_path, docker_shim):
+    """The gang bootstraps the container per host, then runs setup and
+    run phases through docker exec with the rank env intact."""
+    out = tmp_path / 'rank-out'
+    out.mkdir()
+    spec = {
+        'setup': 'echo setup-done',
+        'run': f'echo rank=$SKYTPU_NODE_RANK > {out}/r$SKYTPU_NODE_RANK',
+        'nodes': [['127.0.0.1']],
+        'chips_per_host': 4,
+        'is_local': True,
+        'envs': {},
+        'docker_image': 'python:3.11-slim',
+    }
+    statuses = []
+    rc = gang_lib.run_gang_job(
+        1, spec, str(tmp_path / 'logs'),
+        lambda s, r: statuses.append((s, r)))
+    assert rc == 0
+    assert statuses[-1][0] is job_queue.JobStatus.SUCCEEDED
+    calls = docker_shim.read_text()
+    assert 'pull python:3.11-slim' in calls
+    assert '--privileged' in calls and '--network=host' in calls
+    assert 'exec skytpu-ct' in calls
+    # Rank env crossed the docker exec boundary into the task.
+    assert (out / 'r0').read_text().strip() == 'rank=0'
+    # Bootstrap phase got its own log file.
+    assert (tmp_path / 'logs' / 'docker-init-0.log').exists()
+
+
+def test_gang_docker_bootstrap_failure_is_setup_failure(tmp_path,
+                                                        monkeypatch):
+    shim_dir = tmp_path / 'shim'
+    shim_dir.mkdir()
+    shim = shim_dir / 'docker'
+    shim.write_text('#!/usr/bin/env bash\nexit 7\n')
+    shim.chmod(shim.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv('PATH',
+                       f'{shim_dir}{os.pathsep}{os.environ["PATH"]}')
+    spec = {
+        'run': 'echo never',
+        'nodes': [['127.0.0.1']],
+        'chips_per_host': 0,
+        'is_local': True,
+        'envs': {},
+        'docker_image': 'broken:img',
+    }
+    statuses = []
+    rc = gang_lib.run_gang_job(
+        2, spec, str(tmp_path / 'logs'),
+        lambda s, r: statuses.append((s, r)))
+    assert rc != 0
+    assert statuses[-1][0] is job_queue.JobStatus.FAILED_SETUP

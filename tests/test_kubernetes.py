@@ -139,3 +139,27 @@ def test_spot_renders_gke_spot_selector(fake_k8s):
         'true'
     assert pod['spec']['tolerations'][0]['key'] == \
         'cloud.google.com/gke-spot'
+
+
+def test_k8s_metrics_scrape(fake_k8s):
+    """Pod cpu/memory usage + TPU chip requests land in the server's
+    Prometheus gauges (parity: sky/metrics/utils.py:218-424)."""
+    from skypilot_tpu import metrics_utils
+    from skypilot_tpu.server import metrics as metrics_lib
+    provision.run_instances(
+        'kubernetes',
+        _config('metricsc', accelerators='tpu-v5e-4'))
+    rows = metrics_utils.scrape_once()
+    by_pod = {r['pod']: r for r in rows}
+    assert 'metricsc-0' in by_pod
+    row = by_pod['metricsc-0']
+    assert row['cluster'] == 'metricsc'
+    assert row['tpu_chips'] == 4
+    assert row['cpu_millicores'] == 250.0
+    assert row['memory_bytes'] == 2**30
+    text = metrics_lib.render()
+    assert ('skytpu_k8s_pod_tpu_chips{cluster="metricsc",'
+            'pod="metricsc-0"} 4') in text
+    assert 'skytpu_k8s_pod_cpu_millicores' in text
+    # maybe_scrape is daemon-safe: configured -> scrapes
+    assert metrics_utils.maybe_scrape() >= 1

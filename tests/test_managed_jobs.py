@@ -22,7 +22,13 @@ from skypilot_tpu.task import Task
 @pytest.fixture
 def jobs_env(tmp_home, enable_all_clouds, monkeypatch):
     monkeypatch.setenv('SKYTPU_JOBS_POLL_INTERVAL', '0.25')
-    return tmp_home
+    yield tmp_home
+    # A controller thread outliving this test keeps polling under the
+    # NEXT test's $HOME and mutates its jobs DB (observed under -n 4:
+    # 'cluster jobs-1-t1-two lost; recovery' firing inside unrelated
+    # tests).  Stop them without status writes.
+    from skypilot_tpu.jobs import controller as controller_lib
+    controller_lib.stop_all_controllers()
 
 
 def _local_task(run, name='mj', **kwargs):

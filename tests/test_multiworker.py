@@ -22,7 +22,8 @@ def _start_multiworker(port, env, workers=2):
         [sys.executable, '-m', 'skypilot_tpu.server.app', '--port',
          str(port), '--workers', str(workers)],
         env=env, stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
-    deadline = time.time() + 45
+    # Generous: two spawn workers importing under suite contention.
+    deadline = time.time() + 180
     while time.time() < deadline:
         try:
             if requests_lib.get(f'http://127.0.0.1:{port}/api/health',

@@ -262,3 +262,43 @@ def test_warns_when_rbac_relies_on_shared_token(tmp_home, monkeypatch):
     _write_cfg(tmp_home, 'api_server:\n  auth_token: sekrit\n')
     sky_config.reset_cache_for_tests()
     assert auth.warn_if_spoofable_rbac(logger) is False
+
+
+def test_requests_listing_scoped_by_user(api_server, tmp_home):
+    """With RBAC on, a non-admin lists only their own requests (plus
+    unattributed ones); admins see everything; fetching another user's
+    request by id is denied."""
+    _write_cfg(tmp_home, 'users:\n  alice: admin\n  bob: user\n'
+               '  eve: user\n')
+    body = {'task': _mk_local_task().to_yaml_config(),
+            'cluster_name': 'reqscope'}
+    rid = requests_lib.post(f'{api_server}/launch', json=body,
+                            headers={USER_HEADER: 'bob'}
+                            ).json()['request_id']
+    import time
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        rec = requests_lib.get(f'{api_server}/requests/{rid}',
+                               headers={USER_HEADER: 'bob'}).json()
+        if rec['status'] in ('SUCCEEDED', 'FAILED'):
+            break
+        time.sleep(0.3)
+    assert rec['status'] == 'SUCCEEDED', rec.get('error')
+
+    def ids_as(user):
+        recs = requests_lib.get(f'{api_server}/requests',
+                                headers={USER_HEADER: user}).json()
+        return [r['request_id'] for r in recs]
+
+    assert rid in ids_as('bob')
+    assert rid in ids_as('alice')     # admin sees all
+    assert rid not in ids_as('eve')   # other non-admin does not
+    r = requests_lib.get(f'{api_server}/requests/{rid}',
+                         headers={USER_HEADER: 'eve'})
+    assert r.status_code == 403
+    assert requests_lib.get(f'{api_server}/requests/{rid}',
+                            headers={USER_HEADER: 'bob'}).ok
+    # cleanup
+    requests_lib.post(f'{api_server}/down',
+                      json={'cluster_name': 'reqscope'},
+                      headers={USER_HEADER: 'bob'})
