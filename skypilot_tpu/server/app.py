@@ -240,7 +240,9 @@ def make_app() -> web.Application:
         reads; in-flight worker processes run to completion (the
         process-level wait happens in on_shutdown / executor.drain).
         Multi-worker: the flag is written to the shared DB so every
-        sibling worker drains too, whichever one served this POST."""
+        sibling worker drains too, whichever one served this POST —
+        siblings pick it up within the flag cache TTL (~1s, eventual
+        consistency; _is_draining)."""
         app['draining'] = True
         if app.get('multi_worker'):
             from skypilot_tpu.server import requests_db

@@ -176,18 +176,21 @@ def test_server_killed_mid_launch_worker_adopted(chaos_server):
     env = chaos_server['env']
     rid = requests_lib.post(
         f'http://127.0.0.1:{port}/launch',
-        json=_launch_body(run='sleep 3 && echo adopted-done',
+        json=_launch_body(run='sleep 10 && echo adopted-done',
                           cluster='adoptc'),
         timeout=30).json()['request_id']
     # Wait until the request is RUNNING (worker spawned), then murder
-    # the server before the worker finishes.
-    deadline = time.time() + 60
+    # the server before the worker finishes.  Generous: the worker is a
+    # fresh process spawn and can take >60s under -n 4 contention.
+    deadline = time.time() + 180
     while time.time() < deadline:
         rec = requests_lib.get(
             f'http://127.0.0.1:{port}/requests/{rid}',
             timeout=10).json()
         if rec['status'] == 'RUNNING' and rec.get('pid'):
             break
+        if rec['status'] in ('SUCCEEDED', 'FAILED', 'CANCELLED'):
+            break      # fail fast below instead of burning the deadline
         time.sleep(0.1)
     assert rec['status'] == 'RUNNING', rec
     worker_pid = rec['pid']

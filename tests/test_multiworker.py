@@ -145,6 +145,11 @@ def test_drain_gates_every_worker(mw_server):
         r = requests_lib.post(f'http://127.0.0.1:{port}/api/drain',
                               timeout=10)
         assert r.ok
+        # Drain reaches sibling workers through the shared DB flag,
+        # TTL-cached (_is_draining) — eventual consistency by design;
+        # wait out the propagation window before asserting.
+        from skypilot_tpu.server import app as app_lib
+        time.sleep(app_lib._DRAIN_FLAG_TTL_S + 0.5)
         # Many attempts so the kernel's SO_REUSEPORT hashing spreads
         # them over both workers: every single one must be refused.
         for _ in range(10):
