@@ -86,6 +86,18 @@ async def _error_middleware(request, handler):
 @web.middleware
 async def _auth_middleware(request, handler):
     from skypilot_tpu.utils import auth
+    proxy_cfg = auth.get_auth_proxy_config()
+    if proxy_cfg is not None and request.path not in ('/api/health',):
+        # Auth-proxy mode (parity: sky/server/auth/oauth2_proxy.py):
+        # an authenticating reverse proxy did the OAuth2/OIDC flow and
+        # vouches with a shared secret; its identity header IS the user.
+        ok, user = auth.authenticate_proxy(request.headers, proxy_cfg)
+        if not ok:
+            return web.json_response(
+                {'error': 'unauthorized (requests must come through '
+                          'the auth proxy)'}, status=401)
+        request['auth_user'] = user
+        return await handler(request)
     auth_on = _auth_token() or auth.get_token_users()
     if auth_on and request.path not in ('/api/health', '/', '/dashboard'):
         header = request.headers.get('Authorization', '')

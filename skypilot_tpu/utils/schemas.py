@@ -141,6 +141,19 @@ CONFIG_SCHEMA: Dict[str, Any] = {
                     'type': 'object',
                     'additionalProperties': {'type': 'string'},
                 },
+                # Behind an authenticating reverse proxy (oauth2-proxy
+                # parity): the proxy's shared secret authorizes, its
+                # identity header names the user (utils/auth.py).
+                'auth_proxy': {
+                    'type': 'object',
+                    'additionalProperties': False,
+                    'properties': {
+                        'identity_header': {'type': 'string'},
+                        'secret_header': {'type': 'string'},
+                        'proxy_secret': {'type': 'string'},
+                    },
+                    'required': ['proxy_secret'],
+                },
             },
         },
         'gcp': {

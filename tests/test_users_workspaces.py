@@ -302,3 +302,60 @@ def test_requests_listing_scoped_by_user(api_server, tmp_home):
     requests_lib.post(f'{api_server}/down',
                       json={'cluster_name': 'reqscope'},
                       headers={USER_HEADER: 'bob'})
+
+
+# ----- auth-proxy mode (oauth2-proxy parity) ---------------------------------
+def test_auth_proxy_mode(api_server, tmp_home):
+    """Behind an authenticating reverse proxy (api_server.auth_proxy):
+    only requests carrying the proxy's shared secret are served, the
+    proxied identity header becomes the user (email local part), and a
+    client-forged X-SkyTPU-User is ignored."""
+    _write_cfg(tmp_home,
+               'api_server:\n'
+               '  auth_proxy:\n'
+               '    proxy_secret: s3cr3t\n'
+               'users:\n  alice: admin\n  bob: user\n')
+    from skypilot_tpu import sky_config
+    sky_config.reset_cache_for_tests()
+    try:
+        # Direct access (no proxy secret): rejected.
+        r = requests_lib.get(f'{api_server}/status')
+        assert r.status_code == 401
+        # Forged identity without the secret: rejected.
+        r = requests_lib.get(
+            f'{api_server}/status',
+            headers={'X-Auth-Request-Email': 'alice@corp'})
+        assert r.status_code == 401
+        # Through the proxy: identity comes from the proxy header; a
+        # client-supplied X-SkyTPU-User is ignored.
+        body = {'task': _mk_local_task().to_yaml_config(),
+                'cluster_name': 'oauthc'}
+        r = requests_lib.post(
+            f'{api_server}/launch', json=body,
+            headers={'X-SkyTPU-Proxy-Secret': 's3cr3t',
+                     'X-Auth-Request-Email': 'bob@corp.example',
+                     USER_HEADER: 'alice'})
+        assert r.status_code == 200
+        rid = r.json()['request_id']
+        import time
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            rec = requests_lib.get(
+                f'{api_server}/requests/{rid}',
+                headers={'X-SkyTPU-Proxy-Secret': 's3cr3t',
+                         'X-Auth-Request-Email': 'bob@corp.example'}
+            ).json()
+            if rec['status'] in ('SUCCEEDED', 'FAILED'):
+                break
+            time.sleep(0.3)
+        assert rec['status'] == 'SUCCEEDED', rec.get('error')
+        assert rec['user'] == 'bob'   # proxied identity, not the forgery
+        rec = global_user_state.get_cluster('oauthc')
+        assert rec['user_name'] == 'bob'
+        # /api/health stays open for probes.
+        assert requests_lib.get(f'{api_server}/api/health').ok
+    finally:
+        requests_lib.post(f'{api_server}/down',
+                          json={'cluster_name': 'oauthc'},
+                          headers={'X-SkyTPU-Proxy-Secret': 's3cr3t',
+                                   'X-Auth-Request-Email': 'bob@corp'})
