@@ -90,12 +90,26 @@ async def _auth_middleware(request, handler):
     if proxy_cfg is not None and request.path not in ('/api/health',):
         # Auth-proxy mode (parity: sky/server/auth/oauth2_proxy.py):
         # an authenticating reverse proxy did the OAuth2/OIDC flow and
-        # vouches with a shared secret; its identity header IS the user.
+        # vouches with a shared secret; its identity header IS the
+        # user.  Per-user service tokens still work WITHOUT the proxy
+        # (headless CI/SDK access, parity: service-account tokens
+        # bypass the reference's oauth2-proxy) — they bind identity
+        # themselves.  The shared auth_token does NOT bypass: it
+        # authorizes without binding identity, which would reopen the
+        # header-spoofing hole proxy mode closes.
+        header = request.headers.get('Authorization', '')
+        supplied = header[7:] if header.startswith('Bearer ') else ''
+        if supplied:
+            ok, user = auth.authenticate(supplied)
+            if ok and user is not None:
+                request['auth_user'] = user
+                return await handler(request)
         ok, user = auth.authenticate_proxy(request.headers, proxy_cfg)
         if not ok:
             return web.json_response(
                 {'error': 'unauthorized (requests must come through '
-                          'the auth proxy)'}, status=401)
+                          'the auth proxy, or carry a per-user service '
+                          'token)'}, status=401)
         request['auth_user'] = user
         return await handler(request)
     auth_on = _auth_token() or auth.get_token_users()

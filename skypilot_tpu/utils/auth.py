@@ -76,11 +76,24 @@ def authenticate(supplied: str) -> Tuple[bool, Optional[str]]:
 
 
 def get_auth_proxy_config() -> Optional[Dict[str, str]]:
-    """Auth-proxy mode config, normalized, or None when not enabled."""
-    from skypilot_tpu import sky_config
+    """Auth-proxy mode config, normalized, or None when not enabled.
+
+    A PRESENT auth_proxy section with an empty proxy_secret (e.g. an
+    unexpanded env template) is a hard error, not 'disabled' — failing
+    open on a typo'd secret would serve the API unauthenticated while
+    the operator believes proxy auth is enforced.  (The config schema
+    also rejects it with minLength; this guards env-injected configs
+    that skip validation.)
+    """
+    from skypilot_tpu import exceptions, sky_config
     cfg = sky_config.get_nested(('api_server', 'auth_proxy'), None)
-    if not isinstance(cfg, dict) or not cfg.get('proxy_secret'):
+    if not isinstance(cfg, dict):
         return None
+    if not str(cfg.get('proxy_secret') or '').strip():
+        raise exceptions.InvalidSkyConfigError(
+            'api_server.auth_proxy is configured but proxy_secret is '
+            'empty — refusing to fail open; set the shared secret or '
+            'remove the auth_proxy section')
     return {
         'identity_header': str(cfg.get('identity_header',
                                        'X-Auth-Request-Email')),

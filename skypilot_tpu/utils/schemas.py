@@ -150,7 +150,8 @@ CONFIG_SCHEMA: Dict[str, Any] = {
                     'properties': {
                         'identity_header': {'type': 'string'},
                         'secret_header': {'type': 'string'},
-                        'proxy_secret': {'type': 'string'},
+                        'proxy_secret': {'type': 'string',
+                                         'minLength': 1},
                     },
                     'required': ['proxy_secret'],
                 },

@@ -1,0 +1,80 @@
+"""Load tier (ref shape: tests/load_tests/ — a concurrent-client load
+generator against the API server).
+
+Hammers one real server process with concurrent readers and writers and
+asserts the service properties that matter under load: no 5xx, every
+launch executes exactly once to completion, reads stay responsive
+(bounded p95) while workers grind, and the server is still healthy
+afterwards.
+"""
+import concurrent.futures
+import time
+
+import requests as requests_lib
+
+from test_chaos import chaos_server  # noqa: F401  (fixture reuse)
+
+
+def _post_launch(port, i):
+    t0 = time.perf_counter()
+    r = requests_lib.post(
+        f'http://127.0.0.1:{port}/launch',
+        json={'task': {'name': f'load{i}',
+                       'run': f'echo load-{i}',
+                       'resources': {'infra': 'local'}},
+              'cluster_name': f'loadc{i % 4}'},
+        timeout=60)
+    return r.status_code, time.perf_counter() - t0, r
+
+def _get(port, path):
+    t0 = time.perf_counter()
+    r = requests_lib.get(f'http://127.0.0.1:{port}{path}', timeout=60)
+    return r.status_code, time.perf_counter() - t0, r
+
+
+def test_concurrent_load(chaos_server):  # noqa: F811
+    port = chaos_server['port']
+    n_launches = 12
+    n_reads = 120
+
+    with concurrent.futures.ThreadPoolExecutor(max_workers=16) as pool:
+        launch_futs = [pool.submit(_post_launch, port, i)
+                       for i in range(n_launches)]
+        read_futs = [pool.submit(_get, port,
+                                 '/status' if i % 2 else '/requests')
+                     for i in range(n_reads)]
+        launches = [f.result() for f in launch_futs]
+        reads = [f.result() for f in read_futs]
+
+    # No 5xx anywhere under concurrent write+read load.
+    assert all(code < 500 for code, _, _ in launches), [
+        (c, r.text[:80]) for c, _, r in launches if c >= 500]
+    assert all(code == 200 for code, _, _ in reads), [
+        (c, r.text[:80]) for c, _, r in reads if c != 200]
+
+    # Reads stay responsive while 12 worker processes grind: generous
+    # p95 bound — this is a smoke bar, not a perf benchmark.
+    lat = sorted(d for _, d, _ in reads)
+    p95 = lat[int(len(lat) * 0.95)]
+    assert p95 < 10.0, f'p95 read latency {p95:.2f}s under load'
+
+    # Every accepted launch runs to completion, exactly once.
+    rids = [r.json()['request_id'] for code, _, r in launches
+            if code == 200]
+    assert len(rids) == n_launches
+    deadline = time.time() + 300
+    statuses = {}
+    while time.time() < deadline:
+        recs = {rec['request_id']: rec for rec in requests_lib.get(
+            f'http://127.0.0.1:{port}/requests?limit=200',
+            timeout=30).json()}
+        statuses = {rid: recs.get(rid, {}).get('status') for rid in rids}
+        if all(s in ('SUCCEEDED', 'FAILED', 'CANCELLED')
+               for s in statuses.values()):
+            break
+        time.sleep(0.5)
+    assert all(s == 'SUCCEEDED' for s in statuses.values()), statuses
+
+    # Server is still healthy after the storm.
+    assert requests_lib.get(f'http://127.0.0.1:{port}/api/health',
+                            timeout=10).json()['status'] == 'healthy'

@@ -314,12 +314,25 @@ def test_auth_proxy_mode(api_server, tmp_home):
                'api_server:\n'
                '  auth_proxy:\n'
                '    proxy_secret: s3cr3t\n'
+               '  tokens:\n'
+               '    svc-tok-1: ci-bot\n'
                'users:\n  alice: admin\n  bob: user\n')
     from skypilot_tpu import sky_config
     sky_config.reset_cache_for_tests()
     try:
         # Direct access (no proxy secret): rejected.
         r = requests_lib.get(f'{api_server}/status')
+        assert r.status_code == 401
+        # Per-user service tokens still work WITHOUT the proxy
+        # (headless CI parity: service accounts bypass oauth2-proxy).
+        r = requests_lib.get(
+            f'{api_server}/status',
+            headers={'Authorization': 'Bearer svc-tok-1'})
+        assert r.status_code == 200
+        # A wrong bearer without proxy headers stays rejected.
+        r = requests_lib.get(
+            f'{api_server}/status',
+            headers={'Authorization': 'Bearer wrong'})
         assert r.status_code == 401
         # Forged identity without the secret: rejected.
         r = requests_lib.get(
@@ -359,3 +372,24 @@ def test_auth_proxy_mode(api_server, tmp_home):
                           json={'cluster_name': 'oauthc'},
                           headers={'X-SkyTPU-Proxy-Secret': 's3cr3t',
                                    'X-Auth-Request-Email': 'bob@corp'})
+
+
+def test_auth_proxy_empty_secret_fails_closed(tmp_home):
+    """A present auth_proxy section with an empty secret (unexpanded
+    env template) is a hard error — never silently-disabled auth."""
+    from skypilot_tpu import exceptions as exc
+    from skypilot_tpu import sky_config
+    from skypilot_tpu.utils import auth, schemas
+    import pytest as _pytest
+    with _pytest.raises(Exception):
+        schemas.validate_config(
+            {'api_server': {'auth_proxy': {'proxy_secret': ''}}})
+    # Env-injected config that skipped schema validation:
+    _write_cfg(tmp_home,
+               'api_server:\n  auth_proxy:\n    proxy_secret: ""\n')
+    sky_config.reset_cache_for_tests()
+    try:
+        with _pytest.raises(exc.InvalidSkyConfigError):
+            auth.get_auth_proxy_config()
+    finally:
+        sky_config.reset_cache_for_tests()
