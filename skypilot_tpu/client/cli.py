@@ -283,6 +283,84 @@ def volumes_delete_cmd(name):
 
 
 @cli.group()
+def storage():
+    """Object-storage buckets (parity: `sky storage` CRUD).
+
+    Operates directly on the store (gsutil; the hermetic fake root in
+    tests) — no server round-trip, matching the reference's
+    client-side storage management."""
+
+
+@storage.command('create')
+@click.argument('bucket')
+@click.option('--region', default=None)
+def storage_create_cmd(bucket, region):
+    """Create a bucket (idempotent)."""
+    from skypilot_tpu.data import storage as storage_lib
+    storage_lib.GcsStore(bucket).create(region=region)
+    click.echo(f'Bucket gs://{bucket} ready.')
+
+
+@storage.command('ls')
+@click.argument('bucket', required=False)
+@click.option('--prefix', default='')
+def storage_ls_cmd(bucket, prefix):
+    """List a bucket's objects (or hint at ls of all buckets)."""
+    from skypilot_tpu.data import storage as storage_lib
+    if not bucket:
+        raise click.UsageError('specify a bucket: skytpu storage ls '
+                               '<bucket>')
+    store = storage_lib.GcsStore(bucket)
+    if not store.exists():
+        raise click.ClickException(f'gs://{bucket} does not exist')
+    for key in store.list_prefix(prefix):
+        click.echo(key)
+
+
+@storage.command('upload')
+@click.argument('bucket')
+@click.argument('src_dir')
+@click.option('--prefix', default='')
+def storage_upload_cmd(bucket, src_dir, prefix):
+    """Upload a directory (honors .skyignore at its root)."""
+    from skypilot_tpu.data import storage as storage_lib
+    store = storage_lib.GcsStore(bucket)
+    if not store.exists():
+        store.create()
+    store.sync_up(src_dir, prefix=prefix)
+    click.echo(f'Uploaded {src_dir} -> gs://{bucket}/{prefix}'.rstrip('/'))
+
+
+@storage.command('download')
+@click.argument('bucket')
+@click.argument('dst_dir')
+@click.option('--prefix', default='')
+def storage_download_cmd(bucket, dst_dir, prefix):
+    """Download a bucket (or prefix) into a local directory."""
+    from skypilot_tpu.data import storage as storage_lib
+    store = storage_lib.GcsStore(bucket)
+    if not store.exists():
+        # A typo'd bucket must error, not 'succeed' with an empty dir.
+        raise click.ClickException(f'gs://{bucket} does not exist')
+    store.sync_down(dst_dir, prefix=prefix)
+    click.echo(f'Downloaded gs://{bucket}/{prefix} -> {dst_dir}'
+               .rstrip('/'))
+
+
+@storage.command('delete')
+@click.argument('bucket')
+@click.option('--yes', '-y', is_flag=True, default=False)
+def storage_delete_cmd(bucket, yes):
+    """Delete a bucket and everything in it."""
+    if not yes:
+        click.confirm(f'Delete gs://{bucket} and ALL its objects?',
+                      abort=True)
+    from skypilot_tpu.data import storage as storage_lib
+    storage_lib.GcsStore(bucket).delete()
+    click.echo(f'Bucket gs://{bucket} deleted.')
+
+
+@cli.group()
 def jobs():
     """Managed jobs: auto-recovering tasks on preemptible TPU slices."""
 
@@ -387,7 +465,7 @@ def serve():
 
 @serve.command('up')
 @click.argument('entrypoint', nargs=-1)
-@click.option('--service-name', '-n', default=None)
+@click.option('--service-name', default=None)
 @_apply(_task_options)
 def serve_up_cmd(entrypoint, service_name, cluster, detach_run,
                  **overrides):
@@ -397,6 +475,21 @@ def serve_up_cmd(entrypoint, service_name, cluster, detach_run,
     result = sdk.get(sdk.serve_up(task, service_name))
     click.echo(f'Service {result["name"]!r} starting; endpoint: '
                f'{result["endpoint"]}')
+
+
+@serve.command('update')
+@click.argument('entrypoint', nargs=-1)
+@click.option('--service-name', default=None)
+@_apply(_task_options)
+def serve_update_cmd(entrypoint, service_name, cluster, detach_run,
+                     **overrides):
+    """Rolling update of a live service to a new task YAML: new-version
+    replicas surge up, old ones drain only as replacements turn READY."""
+    del cluster, detach_run
+    task = _load_task(entrypoint, **overrides)
+    result = sdk.get(sdk.serve_update(task, service_name))
+    click.echo(f'Service {result["name"]!r}: rolling update to '
+               f'v{result["version"]} started.')
 
 
 @serve.command('down')

@@ -248,6 +248,13 @@ def serve_up(task: task_lib.Task,
                                'name': service_name})['request_id']
 
 
+def serve_update(task: task_lib.Task,
+                 service_name: Optional[str] = None) -> str:
+    """Rolling update of a live service to a new task/spec."""
+    return _post('/serve/update', {'task': task.to_yaml_config(),
+                                   'name': service_name})['request_id']
+
+
 def serve_down(service_name: str, purge: bool = False) -> str:
     return _post('/serve/down', {'name': service_name,
                                  'purge': purge})['request_id']

@@ -6,6 +6,8 @@ from skypilot_tpu.serve.core import down
 from skypilot_tpu.serve.core import status
 from skypilot_tpu.serve.core import tail_replica_logs
 from skypilot_tpu.serve.core import up
+from skypilot_tpu.serve.core import update
 from skypilot_tpu.serve.service_spec import ServiceSpec
 
-__all__ = ['up', 'down', 'status', 'tail_replica_logs', 'ServiceSpec']
+__all__ = ['up', 'down', 'status', 'tail_replica_logs', 'update',
+           'ServiceSpec']

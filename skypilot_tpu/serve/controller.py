@@ -47,6 +47,7 @@ class ServiceController:
         self.service_name = service_name
         self.spec = ServiceSpec.from_yaml_config(rec['spec'])
         self.task = task_lib.Task.from_yaml_config(rec['task_config'])
+        self.version = rec['version']
         placer: Optional[SpotPlacer] = None
         if self.task.any_resources.use_spot:
             try:
@@ -55,7 +56,8 @@ class ServiceController:
                 zones = []
             placer = SpotPlacer(zones)
         self.manager = ReplicaManager(service_name, self.spec, self.task,
-                                      spot_placer=placer)
+                                      spot_placer=placer,
+                                      version=self.version)
         self.lb = LoadBalancer(
             service_name, rec['lb_port'],
             LoadBalancingPolicy.make(self.spec.load_balancing_policy),
@@ -99,8 +101,42 @@ class ServiceController:
                 serve_state.set_service_status(self.service_name,
                                                ServiceStatus.SHUTDOWN)
                 return
+            if rec['version'] != self.version:
+                # `serve update`: adopt the new spec/task; rollout_step
+                # below drains old-version replicas as new ones ready.
+                # EVERY spec-derived object is rebuilt — autoscaler, LB
+                # policy, spot placer — or a changed
+                # load_balancing_policy / use_spot would silently keep
+                # v(old) behavior until a server restart.
+                logger.info(f'Service {self.service_name!r}: updating '
+                            f'v{self.version} -> v{rec["version"]}.')
+                self.version = rec['version']
+                self.spec = ServiceSpec.from_yaml_config(rec['spec'])
+                self.task = task_lib.Task.from_yaml_config(
+                    rec['task_config'])
+                placer = None
+                if self.task.any_resources.use_spot:
+                    try:
+                        zones = catalog.get_zones(self.task.any_resources)
+                    except Exception:  # pylint: disable=broad-except
+                        zones = []
+                    placer = SpotPlacer(zones)
+                self.manager.spot_placer = placer
+                self.manager.set_template(self.spec, self.task,
+                                          self.version)
+                self.lb.policy = LoadBalancingPolicy.make(
+                    self.spec.load_balancing_policy)
+                self.autoscaler = Autoscaler.make(
+                    self.spec, _tick_interval(), _qps_window())
             now = time.time()
             self.manager.probe_and_reconcile(now)
+            if self.manager.rollout_step():
+                # Mid-rollout: the surge/drain logic owns replica
+                # counts; autoscaling resumes when no old replicas
+                # remain.
+                self._update_service_status()
+                _shutdown.wait(_tick_interval())
+                continue
             decision = self.autoscaler.evaluate(
                 list(self.lb.request_timestamps), self.manager.num_live(),
                 now)

@@ -46,6 +46,33 @@ def up(task: task_lib.Task, service_name: Optional[str] = None,
     return {'name': name, 'endpoint': endpoint}
 
 
+def update(task: task_lib.Task,
+           service_name: Optional[str] = None) -> Dict[str, Any]:
+    """Rolling update of a live service to a new task/spec (parity:
+    `sky serve update`): the stored spec is replaced under a bumped
+    version; the controller surges new-version replicas and drains old
+    ones only as replacements turn READY, so the endpoint never goes
+    empty.  Returns {'name', 'version'}."""
+    if task.service is None:
+        raise exceptions.InvalidTaskError(
+            'task has no `service:` section; add a readiness_probe and '
+            'replica policy to serve it')
+    spec = ServiceSpec.from_yaml_config(task.service)
+    name = service_name or task.name or 'service'
+    version = serve_state.update_service(name, spec.to_yaml_config(),
+                                         task.to_yaml_config())
+    if version is None:
+        raise exceptions.ServeError(
+            f'service {name!r} not found or terminal; `serve up` it '
+            f'instead')
+    # The controller observes the version bump on its next tick; if it
+    # died, re-adopt so the rollout actually runs.
+    controller_lib.maybe_start_controllers()
+    logger.info(f'Service {name!r}: rolling update to v{version} '
+                f'started.')
+    return {'name': name, 'version': version}
+
+
 def down(service_name: str, purge: bool = False) -> None:
     """Tear a service down: replicas, LB, controller.
 

@@ -46,16 +46,27 @@ class ReplicaManager:
 
     def __init__(self, service_name: str, spec: ServiceSpec,
                  task: task_lib.Task,
-                 spot_placer: Optional[SpotPlacer] = None) -> None:
+                 spot_placer: Optional[SpotPlacer] = None,
+                 version: int = 1) -> None:
         self.service_name = service_name
         self.spec = spec
         self.task = task
         self.spot_placer = spot_placer
+        self.version = version
         self.backend = TpuVmBackend()
         self._launch_threads: Dict[int, threading.Thread] = {}
         # replica_id -> consecutive probe failures
         self._probe_failures: Dict[int, int] = {}
         self._lock = threading.Lock()
+
+    def set_template(self, spec: ServiceSpec, task: task_lib.Task,
+                     version: int) -> None:
+        """Adopt a new service version (`serve update`): every replica
+        launched from here on runs the new task; rollout_step drains
+        the old ones."""
+        self.spec = spec
+        self.task = task
+        self.version = version
 
     # ----- naming -------------------------------------------------------------
     def _cluster_name(self, replica_id: int) -> str:
@@ -94,7 +105,7 @@ class ReplicaManager:
             serve_state.add_replica(
                 self.service_name, replica_id,
                 self._cluster_name(replica_id),
-                is_spot=is_spot, zone=zone)
+                is_spot=is_spot, zone=zone, version=self.version)
             th = threading.Thread(
                 target=self._launch_replica,
                 args=(replica_id, zone, is_spot),
@@ -206,6 +217,54 @@ class ReplicaManager:
     def terminate_all(self) -> None:
         for rec in serve_state.get_replicas(self.service_name):
             self.terminate_replica(rec['replica_id'])
+
+    # ----- rolling update -----------------------------------------------------
+    def rollout_step(self) -> bool:
+        """One tick of a rolling update; True while old-version
+        replicas remain (the controller suspends autoscaling then).
+
+        Surge-then-drain: launch new-version replicas up to the
+        rollout target (max of min_replicas and either generation's
+        live count — stateless, so a controller re-adopted mid-rollout
+        just continues), then terminate old replicas at most as fast
+        as new ones turn READY, so the LB never goes empty.
+        """
+        live = serve_state.get_replicas(self.service_name)
+        old = [r for r in live if r['version'] < self.version]
+        if not old:
+            return False
+        new = [r for r in live if r['version'] >= self.version]
+        target = max(self.spec.min_replicas, len(old), len(new))
+        if len(new) < target:
+            logger.info(
+                f'Service {self.service_name!r}: rolling update to '
+                f'v{self.version} — surging {target - len(new)} new '
+                f'replica(s) ({len(old)} old remain).')
+            self.scale_up(target - len(new))
+        ready_new = sum(1 for r in new
+                        if r['status'] is ReplicaStatus.READY)
+        ready_old = sum(1 for r in old
+                        if r['status'] is ReplicaStatus.READY)
+        # Drain budget = READY capacity SURPLUS above target (counting
+        # both generations) — not the raw new-READY count, which would
+        # re-spend the same new replicas every tick and drain below
+        # target (or to zero) while later replacements are still
+        # starting.
+        budget = max(0, ready_new + ready_old - target)
+        # Oldest first; non-READY old replicas cost no availability and
+        # are drained immediately.
+        for rec in sorted(old, key=lambda r: r['replica_id']):
+            if rec['status'] is not ReplicaStatus.READY:
+                self.terminate_replica(rec['replica_id'])
+                continue
+            if budget > 0:
+                budget -= 1
+                logger.info(
+                    f'Service {self.service_name!r}: draining '
+                    f'v{rec["version"]} replica {rec["replica_id"]} '
+                    f'({ready_new} v{self.version} replica(s) READY).')
+                self.terminate_replica(rec['replica_id'])
+        return True
 
     def _teardown_cluster(self, cluster_name: str) -> None:
         record = global_user_state.get_cluster(cluster_name)

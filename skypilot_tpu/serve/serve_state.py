@@ -73,6 +73,12 @@ _DDL = [
         launched_at REAL,
         PRIMARY KEY (service_name, replica_id)
     )""",
+    # Rolling updates (`serve update`): the service spec/task carry a
+    # version; each replica records the version it was launched from,
+    # and the controller drains older-version replicas as newer ones
+    # turn READY (parity: sky/serve service versions).
+    'ALTER TABLE services ADD COLUMN version INTEGER DEFAULT 1',
+    'ALTER TABLE replicas ADD COLUMN version INTEGER DEFAULT 1',
 ]
 
 
@@ -144,7 +150,29 @@ def _service_row(row) -> Dict[str, Any]:
         'lb_port': row['lb_port'],
         'created_at': row['created_at'],
         'failure_reason': row['failure_reason'],
+        'version': int(row['version'] or 1),
     }
+
+
+def update_service(name: str, spec: Dict[str, Any],
+                   task_config: Dict[str, Any]) -> Optional[int]:
+    """Store a new spec/task for a LIVE service, bumping its version;
+    returns the new version (the controller rolls replicas to it), or
+    None if the service does not exist / is terminal."""
+    path = _ensure()
+    with db_utils.transaction(path) as conn:
+        row = conn.execute(
+            'SELECT status, version FROM services WHERE name=?',
+            (name,)).fetchone()
+        if row is None or ServiceStatus(row['status']).is_terminal():
+            return None
+        new_version = int(row['version'] or 1) + 1
+        conn.execute(
+            'UPDATE services SET spec=?, task_config=?, version=? '
+            'WHERE name=?',
+            (json.dumps(spec), json.dumps(task_config), new_version,
+             name))
+        return new_version
 
 
 # ----- replicas ---------------------------------------------------------------
@@ -158,14 +186,15 @@ def next_replica_id(service_name: str) -> int:
 
 
 def add_replica(service_name: str, replica_id: int, cluster_name: str,
-                is_spot: bool = False, zone: Optional[str] = None) -> None:
+                is_spot: bool = False, zone: Optional[str] = None,
+                version: int = 1) -> None:
     db_utils.execute(
         _ensure(), 'INSERT OR REPLACE INTO replicas (replica_id, '
-        'service_name, cluster_name, status, is_spot, zone, launched_at) '
-        'VALUES (?,?,?,?,?,?,?)',
+        'service_name, cluster_name, status, is_spot, zone, launched_at, '
+        'version) VALUES (?,?,?,?,?,?,?,?)',
         (replica_id, service_name, cluster_name,
          ReplicaStatus.PROVISIONING.value, int(is_spot), zone,
-         time.time()))
+         time.time(), version))
 
 
 def set_replica_status(service_name: str, replica_id: int,
@@ -227,4 +256,5 @@ def _replica_row(row) -> Dict[str, Any]:
         'is_spot': bool(row['is_spot']),
         'zone': row['zone'],
         'launched_at': row['launched_at'],
+        'version': int(row['version'] or 1),
     }

@@ -593,6 +593,27 @@ def make_app() -> web.Application:
             'serve_up', body, _with_identity(request, work), long=False)
         return web.json_response({'request_id': request_id})
 
+    async def serve_update(request):
+        body = await _json_body(request, 'serve_update')
+
+        def build_task():
+            from skypilot_tpu import admin_policy
+            return admin_policy.apply(
+                task_lib.Task.from_yaml_config(body['task']), 'serve')
+
+        task = _with_identity(request, build_task)()
+        name = body.get('name')
+
+        def work():
+            from skypilot_tpu import serve as serve_lib
+            return serve_lib.update(task, name)
+
+        _inject_identity(request, body)
+        request_id = request.app['executor'].submit(
+            'serve_update', body, _with_identity(request, work),
+            long=False)
+        return web.json_response({'request_id': request_id})
+
     async def serve_down(request):
         body = await _json_body(request, 'serve_down')
         name = body['name']
@@ -742,6 +763,7 @@ def make_app() -> web.Application:
     app.router.add_post('/jobs/cancel', jobs_cancel)
     app.router.add_get('/jobs/logs/{job_id}', jobs_logs)
     app.router.add_post('/serve/up', serve_up)
+    app.router.add_post('/serve/update', serve_update)
     app.router.add_post('/serve/down', serve_down)
     app.router.add_get('/serve/status', serve_status)
     app.router.add_get('/serve/logs/{service}/{replica_id}',

@@ -80,6 +80,12 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
         'properties': {'task': _TASK, 'name': {'type': ['string', 'null']}},
         'additionalProperties': False,
     },
+    'serve_update': {
+        'type': 'object',
+        'required': ['task'],
+        'properties': {'task': _TASK, 'name': {'type': ['string', 'null']}},
+        'additionalProperties': False,
+    },
     'volumes_apply': {
         'type': 'object',
         'required': ['name', 'vtype', 'infra', 'size_gb'],

@@ -164,3 +164,32 @@ echo training-done
     log = open(jobs_state.log_path(job_id), 'rb').read().decode()
     assert 'resumed from step' in log
     assert 'training-done' in log
+
+def test_storage_cli_crud(tmp_home, monkeypatch, tmp_path):
+    """skytpu storage create/upload/ls/download/delete round-trip over
+    the hermetic fake store (parity: `sky storage` CRUD)."""
+    from click.testing import CliRunner
+    from skypilot_tpu.client.cli import cli
+    monkeypatch.setenv('SKYTPU_FAKE_GCS_ROOT', str(tmp_path / 'gcs'))
+    (tmp_path / 'gcs').mkdir()
+    src = tmp_path / 'src'
+    src.mkdir()
+    (src / 'a.txt').write_text('alpha')
+    (src / 'sub').mkdir()
+    (src / 'sub' / 'b.txt').write_text('beta')
+    runner = CliRunner()
+    r = runner.invoke(cli, ['storage', 'create', 'clib'])
+    assert r.exit_code == 0, r.output
+    r = runner.invoke(cli, ['storage', 'upload', 'clib', str(src)])
+    assert r.exit_code == 0, r.output
+    r = runner.invoke(cli, ['storage', 'ls', 'clib'])
+    assert r.exit_code == 0, r.output
+    assert 'a.txt' in r.output and 'sub/b.txt' in r.output
+    down = tmp_path / 'down'
+    r = runner.invoke(cli, ['storage', 'download', 'clib', str(down)])
+    assert r.exit_code == 0, r.output
+    assert (down / 'sub' / 'b.txt').read_text() == 'beta'
+    r = runner.invoke(cli, ['storage', 'delete', 'clib', '--yes'])
+    assert r.exit_code == 0, r.output
+    r = runner.invoke(cli, ['storage', 'ls', 'clib'])
+    assert r.exit_code != 0   # gone
