@@ -115,6 +115,14 @@ def test_managed_job_restarts_on_user_failure_then_fails(jobs_env,
     # initial attempt + 2 restarts
     assert len(marker.read_text().splitlines()) == 3
     rec = jobs_state.get(job_id)
+    # The controller writes FAILED before strategy.cleanup() finishes
+    # tearing the cluster down (terminal status must land even if
+    # cleanup crashes) — poll for the teardown instead of asserting it
+    # instantaneously.
+    deadline = time.time() + 30
+    while time.time() < deadline and \
+            global_user_state.get_cluster(rec['cluster_name']) is not None:
+        time.sleep(0.2)
     assert global_user_state.get_cluster(rec['cluster_name']) is None
 
 
