@@ -87,9 +87,18 @@ def launch(
     backend = TpuVmBackend()
     from skypilot_tpu.utils import timeline
     with timeline.Event('execution.launch', cluster=cluster_name):
-        return _launch_staged(task, cluster_name, minimize, dryrun,
-                              detach_run, stages, quiet_optimizer,
-                              blocked_resources, retry_until_up, backend)
+        result = _launch_staged(task, cluster_name, minimize, dryrun,
+                                detach_run, stages, quiet_optimizer,
+                                blocked_resources, retry_until_up,
+                                backend)
+    if not dryrun:
+        from skypilot_tpu import usage_lib
+        best = task.best_resources
+        usage_lib.record('launch', cluster=cluster_name,
+                         cloud=best.cloud if best else None,
+                         accelerators=(best.accelerator_name
+                                       if best else None))
+    return result
 
 
 def _launch_staged(task, cluster_name, minimize, dryrun, detach_run,

@@ -38,22 +38,14 @@ class GceClient:
                          os.environ.get('SKYTPU_GCE_API_ENDPOINT',
                                         _DEFAULT_ENDPOINT)).rstrip('/')
         self._session = session or requests.Session()
-        self._token: Optional[str] = None
-        self._token_expiry = 0.0
 
-    # ----- auth (same flow as the TPU client) --------------------------------
+    # ----- auth --------------------------------------------------------------
     def _headers(self) -> Dict[str, str]:
         if self.endpoint != _DEFAULT_ENDPOINT:
             return {}  # fake server in tests: no auth
-        if self._token is None or time.time() > self._token_expiry - 60:
-            import google.auth
-            import google.auth.transport.requests
-            creds, _ = google.auth.default(
-                scopes=['https://www.googleapis.com/auth/cloud-platform'])
-            creds.refresh(google.auth.transport.requests.Request())
-            self._token = creds.token
-            self._token_expiry = time.time() + 3000
-        return {'Authorization': f'Bearer {self._token}'}
+        # Process-wide shared credential cache (adaptors/gcp.py).
+        from skypilot_tpu.adaptors import gcp as gcp_adaptor
+        return gcp_adaptor.auth_headers()
 
     def _request(self, method: str, path: str,
                  body: Optional[Dict[str, Any]] = None,

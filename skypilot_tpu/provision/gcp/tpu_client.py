@@ -56,22 +56,15 @@ class TpuClient:
                          os.environ.get('SKYTPU_TPU_API_ENDPOINT',
                                         _DEFAULT_ENDPOINT)).rstrip('/')
         self._session = session or requests.Session()
-        self._token: Optional[str] = None
-        self._token_expiry = 0.0
 
     # ----- auth --------------------------------------------------------------
     def _headers(self) -> Dict[str, str]:
         if self.endpoint != _DEFAULT_ENDPOINT:
             return {}  # fake server in tests: no auth
-        if self._token is None or time.time() > self._token_expiry - 60:
-            import google.auth
-            import google.auth.transport.requests
-            creds, _ = google.auth.default(
-                scopes=['https://www.googleapis.com/auth/cloud-platform'])
-            creds.refresh(google.auth.transport.requests.Request())
-            self._token = creds.token
-            self._token_expiry = time.time() + 3000
-        return {'Authorization': f'Bearer {self._token}'}
+        # Process-wide shared credential cache (adaptors/gcp.py): one
+        # refresh serves every GCP client in this server.
+        from skypilot_tpu.adaptors import gcp as gcp_adaptor
+        return gcp_adaptor.auth_headers()
 
     # ----- plumbing ----------------------------------------------------------
     def _request(self, method: str, path: str,

@@ -43,6 +43,8 @@ def up(task: task_lib.Task, service_name: Optional[str] = None,
     controller_lib.maybe_start_controllers()
     endpoint = f'http://127.0.0.1:{port}'
     logger.info(f'Service {name!r} starting; endpoint: {endpoint}')
+    from skypilot_tpu import usage_lib
+    usage_lib.record('serve_up', service=name)
     return {'name': name, 'endpoint': endpoint}
 
 
@@ -70,6 +72,8 @@ def update(task: task_lib.Task,
     controller_lib.maybe_start_controllers()
     logger.info(f'Service {name!r}: rolling update to v{version} '
                 f'started.')
+    from skypilot_tpu import usage_lib
+    usage_lib.record('serve_update', service=name, version=version)
     return {'name': name, 'version': version}
 
 

@@ -90,6 +90,11 @@ def _k8s_metrics_scrape() -> int:
     return metrics_utils.maybe_scrape()
 
 
+def _usage_heartbeat() -> bool:
+    from skypilot_tpu import usage_lib
+    return usage_lib.heartbeat()
+
+
 def default_daemons() -> List[Daemon]:
     return [
         Daemon('requests-gc', 3600.0, _requests_gc),
@@ -98,6 +103,9 @@ def default_daemons() -> List[Daemon]:
         # Pod cpu/mem/TPU-chip gauges for /metrics (no-op without k8s;
         # ref scrapes GPU metrics similarly, sky/metrics/utils.py:218).
         Daemon('k8s-metrics', 60.0, _k8s_metrics_scrape),
+        # Opt-in fleet-shape heartbeat (no-op unless usage.enabled;
+        # ref: UsageHeartbeatReportEvent, sky/skylet/events.py:153).
+        Daemon('usage-heartbeat', 600.0, _usage_heartbeat),
     ]
 
 

@@ -1,0 +1,117 @@
+"""Opt-in usage telemetry (parity: sky/usage/usage_lib.py:78
+UsageMessageToReport + :295 heartbeat — the reference POSTs to Loki).
+
+Privacy-first redesign: telemetry is OFF unless configured, and the
+default sink is a LOCAL JSONL file — operators aggregate it themselves
+(ship it with logs/, scrape it, or point `endpoint` at a Loki-style
+collector).  Nothing ever leaves the machine without explicit config:
+
+    usage:
+      enabled: true
+      path: ~/.skytpu/usage.jsonl      # local sink (default)
+      endpoint: http://loki:3100/...   # optional HTTP sink
+      labels: {team: ml-infra}         # attached to every event
+
+Events are one JSON object per line: schema_version, ts, event
+(e.g. 'launch', 'serve_up', 'heartbeat'), user, plus caller fields.
+Failures never propagate — telemetry must not break the operation it
+observes.  The server's daemon roster emits a periodic heartbeat with
+coarse fleet counts (clusters/jobs/services) when enabled.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+SCHEMA_VERSION = 1
+
+
+def _config() -> Optional[Dict[str, Any]]:
+    from skypilot_tpu import sky_config
+    cfg = sky_config.get_nested(('usage',), None)
+    if not isinstance(cfg, dict) or not cfg.get('enabled'):
+        return None
+    return cfg
+
+
+def enabled() -> bool:
+    return _config() is not None
+
+
+def record(event: str, **fields: Any) -> bool:
+    """Record one usage event; returns True if it was written.  Never
+    raises (telemetry must not break the operation it observes)."""
+    try:
+        cfg = _config()
+        if cfg is None:
+            return False
+        from skypilot_tpu import users as users_lib
+        msg = {
+            'schema_version': SCHEMA_VERSION,
+            'ts': time.time(),
+            'event': event,
+            'user': users_lib.current_user().name,
+        }
+        labels = cfg.get('labels')
+        if isinstance(labels, dict):
+            msg['labels'] = labels
+        msg.update(fields)
+        line = json.dumps(msg, default=str)
+        path = os.path.expanduser(cfg.get('path') or
+                                  '~/.skytpu/usage.jsonl')
+        os.makedirs(os.path.dirname(path) or '.', exist_ok=True)
+        with open(path, 'a', encoding='utf-8') as f:
+            f.write(line + '\n')
+        endpoint = cfg.get('endpoint')
+        if endpoint:
+            # Fire-and-forget: the HTTP sink must never slow down or
+            # fail the operation it observes (the local JSONL line is
+            # already durable; success below reflects the local sink).
+            import threading
+
+            def _post():
+                try:
+                    import requests as requests_lib
+                    requests_lib.post(
+                        endpoint, data=line,
+                        headers={'Content-Type': 'application/json'},
+                        timeout=5)
+                except Exception as e:  # pylint: disable=broad-except
+                    logger.debug(f'usage endpoint post failed: {e}')
+
+            threading.Thread(target=_post, name='usage-post',
+                             daemon=True).start()
+        return True
+    except Exception as e:  # pylint: disable=broad-except
+        logger.debug(f'usage event {event!r} not recorded: {e}')
+        return False
+
+
+def heartbeat() -> bool:
+    """Periodic fleet-shape heartbeat (server daemon tick; parity:
+    UsageHeartbeatReportEvent, sky/skylet/events.py:153)."""
+    if not enabled():
+        return False
+    try:
+        from skypilot_tpu import global_user_state
+        from skypilot_tpu.global_user_state import ClusterStatus
+        from skypilot_tpu.jobs import state as jobs_state
+        from skypilot_tpu.serve import serve_state
+        clusters = global_user_state.get_clusters()
+        return record(
+            'heartbeat',
+            clusters=len(clusters),
+            clusters_up=sum(1 for c in clusters
+                            if c.get('status') is ClusterStatus.UP),
+            managed_jobs=len(jobs_state.nonterminal_jobs()),
+            services=len(serve_state.list_services()),
+        )
+    except Exception as e:  # pylint: disable=broad-except
+        logger.debug(f'usage heartbeat failed: {e}')
+        return False

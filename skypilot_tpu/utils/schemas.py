@@ -187,6 +187,19 @@ CONFIG_SCHEMA: Dict[str, Any] = {
         },
         'logs': {'type': 'object'},
         'admin_policy': {'type': 'string'},
+        # Opt-in usage telemetry (usage_lib.py): local JSONL sink by
+        # default, optional HTTP endpoint; off unless enabled: true.
+        'usage': {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {
+                'enabled': {'type': 'boolean'},
+                'path': {'type': 'string'},
+                'endpoint': {'type': 'string'},
+                'labels': {'type': 'object',
+                           'additionalProperties': {'type': 'string'}},
+            },
+        },
         'users': {
             'type': 'object',
             'additionalProperties': {'enum': ['admin', 'user']},

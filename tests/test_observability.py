@@ -100,3 +100,43 @@ def test_metrics_endpoint_and_request_instrumentation(
             await client.close()
 
     asyncio.new_event_loop().run_until_complete(drive())
+
+
+# ----- opt-in usage telemetry ------------------------------------------------
+def test_usage_off_by_default(tmp_home):
+    from skypilot_tpu import usage_lib
+    assert not usage_lib.enabled()
+    assert usage_lib.record('launch', cluster='x') is False
+    assert not (tmp_home / '.skytpu' / 'usage.jsonl').exists()
+
+
+def test_usage_records_events_and_heartbeat(tmp_home, enable_all_clouds,
+                                            monkeypatch):
+    """With usage.enabled, launches/serve ops append JSONL events to the
+    LOCAL sink (nothing leaves the machine without an endpoint), and the
+    heartbeat reports fleet shape (parity: sky/usage/usage_lib.py)."""
+    import json
+    (tmp_home / '.skytpu.yaml').write_text(
+        'usage:\n  enabled: true\n  labels: {team: ml}\n')
+    from skypilot_tpu import sky_config, usage_lib
+    sky_config.reset_cache_for_tests()
+    monkeypatch.setenv('SKYTPU_USER', 'tester')
+    from skypilot_tpu import core, execution
+    from skypilot_tpu.resources import Resources
+    from skypilot_tpu.task import Task
+    t = Task('ut', run='echo usage')
+    t.set_resources(Resources.from_yaml_config({'infra': 'local'}))
+    execution.launch(t, 'usagec', detach_run=True)
+    assert usage_lib.heartbeat()
+    core.down('usagec')
+    lines = [json.loads(l) for l in
+             (tmp_home / '.skytpu' / 'usage.jsonl')
+             .read_text().splitlines()]
+    events = {l['event'] for l in lines}
+    assert 'launch' in events and 'heartbeat' in events
+    launch_ev = next(l for l in lines if l['event'] == 'launch')
+    assert launch_ev['cluster'] == 'usagec'
+    assert launch_ev['user'] == 'tester'
+    assert launch_ev['labels'] == {'team': 'ml'}
+    hb = next(l for l in lines if l['event'] == 'heartbeat')
+    assert hb['clusters'] >= 1

@@ -450,3 +450,38 @@ def test_restart_grace_tolerates_stale_terminal_state(fake_tpu):
         th.join()
     finally:
         gcp_instance._recent_restarts.clear()
+
+
+def test_gcp_adaptor_shared_token_cache(monkeypatch):
+    """adaptors/gcp.py: one credential refresh serves every client
+    (parity: sky/adaptors/gcp.py lazy shared SDK state)."""
+    from skypilot_tpu.adaptors import gcp as gcp_adaptor
+    gcp_adaptor.reset_cache_for_tests()
+    calls = {'n': 0}
+
+    class FakeCreds:
+        token = 'tok-123'
+
+        def refresh(self, _request):
+            calls['n'] += 1
+
+    import types
+    fake_auth = types.SimpleNamespace(
+        default=lambda scopes=None: (FakeCreds(), 'proj'),
+        transport=types.SimpleNamespace(
+            requests=types.SimpleNamespace(Request=lambda: None)))
+    import sys
+    monkeypatch.setitem(sys.modules, 'google',
+                        types.SimpleNamespace(auth=fake_auth))
+    monkeypatch.setitem(sys.modules, 'google.auth', fake_auth)
+    monkeypatch.setitem(sys.modules, 'google.auth.transport',
+                        fake_auth.transport)
+    monkeypatch.setitem(sys.modules, 'google.auth.transport.requests',
+                        fake_auth.transport.requests)
+    try:
+        h1 = gcp_adaptor.auth_headers()
+        h2 = gcp_adaptor.auth_headers()
+        assert h1 == h2 == {'Authorization': 'Bearer tok-123'}
+        assert calls['n'] == 1    # cached, not re-refreshed
+    finally:
+        gcp_adaptor.reset_cache_for_tests()
