@@ -71,15 +71,23 @@ for _g in GENERATIONS.values():
 
 _TPU_RE = re.compile(
     r'^tpu[-_]?(?P<gen>v[0-9]+[a-z]*(?:pod|lite)?|trillium)'
-    r'(?:[-:](?P<count>\d+))?$', re.IGNORECASE)
+    r'(?:[-:](?P<count>\d+)(?:x(?P<slices>\d+))?)?$', re.IGNORECASE)
 
 
 @dataclasses.dataclass(frozen=True)
 class TpuType:
-    """A parsed, concrete TPU slice request, e.g. ``tpu-v5p-128``."""
+    """A parsed, concrete TPU slice request, e.g. ``tpu-v5p-128``.
+
+    ``tpu-v5e-64x2`` requests a MULTISLICE cluster: ``num_slices``
+    identically-shaped slices provisioned together and wired over DCN via
+    the libtpu MEGASCALE env contract (parallel/distributed.py).  All
+    per-shape properties (chips, hosts, HBM, TFLOPs) describe ONE slice;
+    callers scale by ``num_slices`` where the whole cluster is meant.
+    """
     generation: str          # canonical generation name
     count_suffix: int        # the number in the accelerator string
     topology: Optional[str] = None   # e.g. '4x4x8'; None = provider default
+    num_slices: int = 1      # >1 = multislice (DCN-connected) cluster
 
     @property
     def gen(self) -> TpuGeneration:
@@ -126,12 +134,20 @@ class TpuType:
 
     @property
     def name(self) -> str:
-        """Canonical accelerator string, e.g. ``tpu-v5p-128``."""
+        """Canonical accelerator string, e.g. ``tpu-v5p-128`` or (multislice)
+        ``tpu-v5e-64x2`` — round-trips through parse_tpu."""
+        base = f'tpu-{self.generation}-{self.count_suffix}'
+        return f'{base}x{self.num_slices}' if self.num_slices > 1 else base
+
+    @property
+    def slice_name(self) -> str:
+        """Per-slice accelerator name (no multislice suffix) — what each
+        provisioned node actually is."""
         return f'tpu-{self.generation}-{self.count_suffix}'
 
     @property
     def gcp_accelerator_type(self) -> str:
-        """The TPU API acceleratorType, e.g. ``v5p-128`` (no ``tpu-``)."""
+        """The TPU API acceleratorType of ONE slice, e.g. ``v5p-128``."""
         return f'{self.generation}-{self.count_suffix}'
 
     @property
@@ -216,7 +232,11 @@ def parse_tpu(accelerator: str) -> TpuType:
         raise exceptions.InvalidAcceleratorError(
             f'{accelerator!r}: core count {count} must be a multiple of '
             f'{g.cores_per_chip} for {gen}.')
-    tpu = TpuType(gen, count)
+    num_slices = int(m.group('slices') or 1)
+    if num_slices < 1:
+        raise exceptions.InvalidAcceleratorError(
+            f'{accelerator!r}: multislice count must be >= 1.')
+    tpu = TpuType(gen, count, num_slices=num_slices)
     chips = tpu.num_chips
     if chips < g.min_chips or chips > g.max_chips:
         raise exceptions.InvalidAcceleratorError(

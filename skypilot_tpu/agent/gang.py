@@ -14,6 +14,9 @@ skylet/constants.py:445, extended with the JAX distributed wiring):
   SKYTPU_COORDINATOR_ADDR  head_ip:8476  (jax.distributed coordinator)
   SKYTPU_NUM_TPU_CHIPS  chips per host
 so user code just calls skypilot_tpu.parallel.maybe_initialize_distributed().
+Clusters spanning >1 TPU slice (multislice ``tpu-...xN`` or num_nodes>1)
+additionally get the libtpu MEGASCALE_* / TPU_WORKER_* multislice contract
+per host (parallel/distributed.py:megascale_env_from_cluster).
 
 Failure policy: any host's non-zero exit fails the whole gang (TPU slices
 are all-or-nothing: a dead host wedges the ICI mesh; the managed-jobs layer
@@ -35,13 +38,35 @@ logger = sky_logging.init_logger(__name__)
 
 def build_host_env(host_ips: List[str], host_rank: int,
                    chips_per_host: int,
-                   extra_env: Optional[Dict[str, str]] = None
+                   extra_env: Optional[Dict[str, str]] = None,
+                   slice_ips: Optional[List[List[str]]] = None
                    ) -> Dict[str, str]:
+    """Per-host env: SKYTPU_* distributed wiring, plus — when the cluster
+    spans multiple TPU slices (``slice_ips`` has >1 entry and the hosts
+    carry chips) — the libtpu MEGASCALE multislice contract
+    (parallel/distributed.py:megascale_env_from_cluster)."""
     env = distributed.distributed_env_from_cluster(host_ips, host_rank)
     env['SKYTPU_NUM_TPU_CHIPS'] = str(chips_per_host)
+    if slice_ips is not None and len(slice_ips) > 1 and chips_per_host > 0:
+        slice_id, rank_in_slice = _locate_host(slice_ips, host_rank)
+        env.update(distributed.megascale_env_from_cluster(
+            slice_ips, slice_id, rank_in_slice))
     if extra_env:
         env.update(extra_env)
     return env
+
+
+def _locate_host(slice_ips: List[List[str]],
+                 global_rank: int) -> tuple:
+    """(slice_id, host_rank_in_slice) of a flat global host rank; ranks
+    enumerate slice 0's hosts first, then slice 1's, matching host_ips."""
+    seen = 0
+    for slice_id, hosts in enumerate(slice_ips):
+        if global_rank < seen + len(hosts):
+            return slice_id, global_rank - seen
+        seen += len(hosts)
+    raise ValueError(
+        f'host rank {global_rank} out of range for slices {slice_ips}')
 
 
 class GangJob:
@@ -102,10 +127,19 @@ class GangJob:
         if self._cancelled:
             return 130
         procs = []
+        # MEGASCALE injection is opt-in via the spec's num_slices (set by
+        # the backend only for explicit multislice requests, tpu-...xN):
+        # libtpu reads MEGASCALE_* at TPU-runtime init regardless of user
+        # code, so injecting it into a plain num_nodes>1 cluster of
+        # independent slices would force DCN mesh bring-up on jobs that
+        # never asked for it.
+        slice_ips = (self.spec.get('nodes', [['127.0.0.1']])
+                     if int(self.spec.get('num_slices', 1)) > 1 else None)
         for rank, ip in enumerate(ips):
             env = dict(envs)
             if inject_rank_env:
-                env.update(build_host_env(ips, rank, chips))
+                env.update(build_host_env(ips, rank, chips,
+                                          slice_ips=slice_ips))
             log_path = os.path.join(self.log_dir, f'{phase}-{rank}.log')
             runner = self._runner_for(ip)
             workdir = self.spec.get('workdir_dest')

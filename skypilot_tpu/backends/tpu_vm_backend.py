@@ -123,7 +123,10 @@ class TpuVmBackend(backend_lib.Backend):
         """Restart a stopped/unhealthy cluster on its original placement."""
         config = ProvisionConfig(
             cluster_name=handle.cluster_name,
-            num_nodes=handle.num_nodes,
+            # One provisioning node per slice: multislice (xN) requests
+            # restart all N slices of every logical node.
+            num_nodes=(handle.num_nodes *
+                       handle.launched_resources().num_slices),
             resources_config=dict(handle.resources_config),
             region=handle.region,
             zone=handle.zone,
@@ -167,7 +170,11 @@ class TpuVmBackend(backend_lib.Backend):
                 raise exceptions.ProvisionError(str(e)) from e
             config = ProvisionConfig(
                 cluster_name=cluster_name,
-                num_nodes=task.num_nodes,
+                # Multislice (tpu-...xN): each slice is its own
+                # provisioning node — N queued-resource creates that
+                # succeed or fail over as one atomic placement (the
+                # failover engine's cleanup_fn deletes partial slices).
+                num_nodes=task.num_nodes * candidate.num_slices,
                 resources_config=candidate.to_yaml_config(),
                 region=candidate.region,
                 zone=candidate.zone,
@@ -424,6 +431,13 @@ class TpuVmBackend(backend_lib.Backend):
         chips_per_host = tpu.chips_per_host if tpu else 0
         spec: Dict[str, Any] = {
             'nodes': handle.node_ips or [['127.0.0.1']],
+            # Explicit multislice (tpu-...xN) ONLY: every provisioned node
+            # is one ICI slice and the gang injects the MEGASCALE contract
+            # so the slices form one DCN-connected XLA computation.  Plain
+            # num_nodes>1 clusters stay independent slices (no MEGASCALE).
+            'num_slices': (len(handle.node_ips)
+                           if res.num_slices > 1 and handle.node_ips
+                           else 1),
             'chips_per_host': chips_per_host,
             'is_local': handle.cloud == 'local',
             'ssh_user': handle.ssh_user,

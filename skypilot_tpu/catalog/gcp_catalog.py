@@ -58,9 +58,12 @@ def list_tpu_offerings(accelerator: str,
                 accelerator=tpu.name,
                 region=r['region'],
                 zone=r['zone'],
-                hourly_cost=float(r['price_chip_hr']) * tpu.num_chips,
+                # Whole REQUEST price: chips per slice x slices (multislice
+                # xN requests pay for N slices).
+                hourly_cost=(float(r['price_chip_hr']) * tpu.num_chips *
+                             tpu.num_slices),
                 hourly_cost_spot=(float(r['spot_price_chip_hr']) *
-                                  tpu.num_chips),
+                                  tpu.num_chips * tpu.num_slices),
             ))
     out.sort(key=lambda o: o.hourly_cost_spot if use_spot else o.hourly_cost)
     return out

@@ -133,18 +133,21 @@ def _constrain_activations(x: jax.Array, mesh: Optional[Mesh],
     if mesh is None:
         return x
     from jax.sharding import NamedSharding, PartitionSpec as P
+    d_dcn = mesh.shape.get('dcn', 1)
     d_data = mesh.shape.get('data', 1)
     d_fsdp = mesh.shape.get('fsdp', 1)
     if context_parallel:
-        batch_axes = 'data' if x.shape[0] % max(d_data, 1) == 0 else None
+        d_batch = d_dcn * d_data
+        batch_axes = (('dcn', 'data')
+                      if x.shape[0] % max(d_batch, 1) == 0 else None)
         seq_axis = 'fsdp' if x.shape[1] % max(d_fsdp, 1) == 0 else None
         spec = P(batch_axes, seq_axis, *([None] * (x.ndim - 2)))
     else:
         d_expert = mesh.shape.get('expert', 1)
-        divisor = max(d_data * d_fsdp * d_expert, 1)
+        divisor = max(d_dcn * d_data * d_fsdp * d_expert, 1)
         if x.shape[0] % divisor != 0:
             return x
-        spec = P(('data', 'fsdp', 'expert'),
+        spec = P(('dcn', 'data', 'fsdp', 'expert'),
                  *([None] * (x.ndim - 1)))
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 

@@ -96,8 +96,9 @@ class MoEMLP(nn.Module):
             return t
         sizes = {
             'expert': self.mesh.shape.get('expert', 1),
-            ('data', 'fsdp'): (self.mesh.shape.get('data', 1) *
-                               self.mesh.shape.get('fsdp', 1)),
+            ('dcn', 'data', 'fsdp'): (self.mesh.shape.get('dcn', 1) *
+                                      self.mesh.shape.get('data', 1) *
+                                      self.mesh.shape.get('fsdp', 1)),
         }
         for dim_idx, axis in enumerate(axes):
             need = sizes.get(axis)
@@ -148,15 +149,17 @@ class MoEMLP(nn.Module):
         # 'expert' is a real mesh axis)
         expert_in = jnp.einsum('bsec,bsd->ebcd', disp, xin)
         expert_in = self._constrain(expert_in, 'expert',
-                                    ('data', 'fsdp'), None, None)
+                                    ('dcn', 'data', 'fsdp'), None, None)
         h = (nn.silu(jnp.einsum('ebcd,edf->ebcf', expert_in, w_gate)) *
              jnp.einsum('ebcd,edf->ebcf', expert_in, w_up))
-        h = self._constrain(h, 'expert', ('data', 'fsdp'), None, 'tensor')
+        h = self._constrain(h, 'expert', ('dcn', 'data', 'fsdp'), None,
+                            'tensor')
         expert_out = jnp.einsum('ebcf,efd->ebcd', h, w_down)
         expert_out = self._constrain(expert_out, 'expert',
-                                     ('data', 'fsdp'), None, None)
+                                     ('dcn', 'data', 'fsdp'), None, None)
         # combine: slots -> tokens, weighted by renormalized gates
         out = jnp.einsum('ebcd,bsec->bsd', expert_out,
                          combine.astype(self.dtype))
-        out = self._constrain(out, ('data', 'fsdp', 'expert'), None, None)
+        out = self._constrain(out, ('dcn', 'data', 'fsdp', 'expert'),
+                              None, None)
         return out.astype(x.dtype)

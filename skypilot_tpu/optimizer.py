@@ -56,8 +56,9 @@ def effective_tflops(candidate: 'resources_lib.Resources',
     tpu = candidate.tpu
     if tpu is None:
         return None
-    # TpuType.bf16_tflops is the slice AGGREGATE (per-chip x chips).
-    return tpu.bf16_tflops * ASSUMED_MFU * num_nodes
+    # TpuType.bf16_tflops is ONE slice's aggregate (per-chip x chips);
+    # multislice (xN) requests deliver N slices per logical node.
+    return tpu.bf16_tflops * ASSUMED_MFU * num_nodes * tpu.num_slices
 
 
 def cost_per_million_tokens(candidate: 'resources_lib.Resources',
@@ -71,7 +72,7 @@ def cost_per_million_tokens(candidate: 'resources_lib.Resources',
     tpu = candidate.tpu
     if tpu is None or params_billion <= 0:
         return None
-    flops_per_s = tpu.bf16_tflops * 1e12 * mfu * num_nodes
+    flops_per_s = tpu.bf16_tflops * 1e12 * mfu * num_nodes * tpu.num_slices
     tokens_per_s = flops_per_s / (6.0 * params_billion * 1e9)
     return hourly_cost / 3600.0 / tokens_per_s * 1e6
 
@@ -165,12 +166,12 @@ def _estimate_runtime_s(task: task_lib.Task,
     min_tflops = None
     for req in task.resources:
         if req.tpu is not None:
-            tflops = req.tpu.bf16_tflops
+            tflops = req.tpu.bf16_tflops * req.tpu.num_slices
             min_tflops = tflops if min_tflops is None else min(
                 min_tflops, tflops)
     if not min_tflops:
         return base
-    return base * min_tflops / tpu.bf16_tflops
+    return base * min_tflops / (tpu.bf16_tflops * tpu.num_slices)
 
 
 def _egress_cost(src: Optional[resources_lib.Resources],

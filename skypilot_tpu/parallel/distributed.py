@@ -4,10 +4,26 @@ The gang executor (agent/gang.py) starts one process per slice host and
 injects:
   SKYTPU_NUM_NODES, SKYTPU_NODE_RANK, SKYTPU_NODE_IPS,
   SKYTPU_COORDINATOR_ADDR (head host ip:port)
-— the analog of the reference's SKYPILOT_* vars (sky/skylet/constants.py:445)
-— plus libtpu/megascale vars for multislice (MEGASCALE_COORDINATOR_ADDRESS
-etc.).  User code calls `maybe_initialize_distributed()` once; single-process
-runs are a no-op so the same script works on one chip and on a pod.
+— the analog of the reference's SKYPILOT_* vars (sky/skylet/constants.py:445).
+
+MULTISLICE clusters (``tpu-v5e-64x2``, or ``num_nodes > 1`` with a TPU
+resource — every provisioned TPU node is one ICI slice) additionally get the
+libtpu MEGASCALE contract per host, which is how DCN-connected slices form
+one XLA computation:
+  MEGASCALE_COORDINATOR_ADDRESS  slice-0 host-0 ip:port (DCN transport init)
+  MEGASCALE_NUM_SLICES           total slice count
+  MEGASCALE_SLICE_ID             which slice this host belongs to
+  MEGASCALE_PORT                 DCN transport port
+plus the per-slice worker identity libtpu needs when it cannot trust VM
+metadata (one TPU VM per slice, N slices on one cluster):
+  TPU_WORKER_ID                  host rank WITHIN its slice
+  TPU_WORKER_HOSTNAMES           comma-joined ips of THIS slice's hosts
+  SKYTPU_NUM_SLICES / SKYTPU_SLICE_ID   framework-level mirrors
+
+User code calls `maybe_initialize_distributed()` once; single-process runs
+are a no-op so the same script works on one chip, a pod, and a multislice
+cluster (jax.distributed spans all hosts of all slices; the `dcn` mesh axis
+in parallel/mesh.py maps data parallelism onto the inter-slice boundary).
 """
 from __future__ import annotations
 
@@ -18,7 +34,10 @@ ENV_NUM_NODES = 'SKYTPU_NUM_NODES'
 ENV_NODE_RANK = 'SKYTPU_NODE_RANK'
 ENV_NODE_IPS = 'SKYTPU_NODE_IPS'
 ENV_COORDINATOR = 'SKYTPU_COORDINATOR_ADDR'
+ENV_NUM_SLICES = 'SKYTPU_NUM_SLICES'
+ENV_SLICE_ID = 'SKYTPU_SLICE_ID'
 DEFAULT_COORDINATOR_PORT = 8476
+DEFAULT_MEGASCALE_PORT = 8081
 
 
 def distributed_env_from_cluster(node_ips: List[str],
@@ -31,6 +50,34 @@ def distributed_env_from_cluster(node_ips: List[str],
         ENV_NODE_RANK: str(node_rank),
         ENV_NODE_IPS: '\n'.join(node_ips),
         ENV_COORDINATOR: f'{node_ips[0]}:{coordinator_port}',
+    }
+
+
+def megascale_env_from_cluster(slice_ips: List[List[str]],
+                               slice_id: int,
+                               host_rank_in_slice: int,
+                               megascale_port: int = DEFAULT_MEGASCALE_PORT
+                               ) -> Dict[str, str]:
+    """libtpu multislice env for ONE host of an N-slice cluster.
+
+    ``slice_ips`` is the per-slice host-ip structure ([[slice0 hosts],
+    [slice1 hosts], ...]).  Injected only when len(slice_ips) > 1: the
+    MEGASCALE vars make libtpu bring up the DCN mesh between slices, and
+    the TPU_WORKER_* vars give each host its identity WITHIN its slice
+    (env analog of the reference's per-node env plumbing,
+    sky/skylet/constants.py:445-450; the reference has no multislice
+    support — this contract follows GKE/libtpu multislice conventions).
+    """
+    return {
+        'MEGASCALE_COORDINATOR_ADDRESS':
+            f'{slice_ips[0][0]}:{megascale_port}',
+        'MEGASCALE_NUM_SLICES': str(len(slice_ips)),
+        'MEGASCALE_SLICE_ID': str(slice_id),
+        'MEGASCALE_PORT': str(megascale_port),
+        'TPU_WORKER_ID': str(host_rank_in_slice),
+        'TPU_WORKER_HOSTNAMES': ','.join(slice_ips[slice_id]),
+        ENV_NUM_SLICES: str(len(slice_ips)),
+        ENV_SLICE_ID: str(slice_id),
     }
 
 

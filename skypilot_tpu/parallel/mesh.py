@@ -3,8 +3,12 @@
 Axes (scaling-book recipe: pick a mesh, annotate shardings, let XLA insert
 collectives):
 
-- ``pipeline`` — GPipe-style stage parallelism (outermost: stage hops are
-                 point-to-point, the one pattern that tolerates DCN)
+- ``dcn``     — inter-slice data parallelism for MULTISLICE clusters
+                (outermost: crosses the DCN network between ICI slices, so
+                only bandwidth-light gradient all-reduces ride it; size =
+                number of slices, 1 on single-slice clusters)
+- ``pipeline`` — GPipe-style stage parallelism (stage hops are
+                 point-to-point, the other pattern that tolerates DCN)
 - ``data``    — pure data parallelism (gradient all-reduce over ICI/DCN)
 - ``fsdp``    — data parallelism with fully-sharded params (ZeRO-3 style);
                 also the context-parallel axis for ring attention (sequence
@@ -25,12 +29,13 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import os
 from typing import Optional, Sequence, Tuple
 
 import jax
 from jax.sharding import Mesh
 
-MESH_AXES = ('pipeline', 'data', 'fsdp', 'expert', 'tensor')
+MESH_AXES = ('dcn', 'pipeline', 'data', 'fsdp', 'expert', 'tensor')
 
 
 def mesh_axes() -> Tuple[str, ...]:
@@ -47,11 +52,12 @@ class MeshPlan:
     tensor: int = 1
     expert: int = 1
     pipeline: int = 1
+    dcn: int = 1
 
     @property
     def num_devices(self) -> int:
         return (self.data * self.fsdp * self.tensor * self.expert *
-                self.pipeline)
+                self.pipeline * self.dcn)
 
     def validate(self, n_devices: int) -> None:
         if self.num_devices != n_devices:
@@ -65,16 +71,32 @@ def plan_mesh(n_devices: int,
               fsdp: Optional[int] = None,
               tensor: Optional[int] = None,
               expert: Optional[int] = None,
-              pipeline: Optional[int] = None) -> MeshPlan:
+              pipeline: Optional[int] = None,
+              dcn: Optional[int] = None) -> MeshPlan:
     """Fill in unset axis sizes.
 
     Policy (matches common TPU practice): tensor/expert/pipeline
     parallelism only when asked; remaining devices default to ``fsdp``,
     which composes with context parallelism and keeps HBM headroom for
-    large models.  `data` absorbs what the caller pins.
+    large models.  `data` absorbs what the caller pins.  ``dcn`` defaults
+    to SKYTPU_NUM_SLICES (injected per host by the gang executor on
+    multislice clusters) so inter-slice data parallelism is automatic;
+    the per-slice axes then divide the per-slice devices.
     """
+    if dcn is None:
+        # On a multislice cluster the gang executor injects
+        # SKYTPU_NUM_SLICES (parallel/distributed.py); default the dcn
+        # axis to it so plan_mesh(jax.device_count()) does the right
+        # thing without the user threading the slice count through.
+        env_slices = int(os.environ.get('SKYTPU_NUM_SLICES', '1'))
+        if env_slices > 1:
+            if n_devices % env_slices != 0:
+                raise ValueError(
+                    f'SKYTPU_NUM_SLICES={env_slices} does not divide the '
+                    f'device count {n_devices}; pass dcn= explicitly.')
+            dcn = env_slices
     known = {'data': data, 'fsdp': fsdp, 'tensor': tensor,
-             'expert': expert, 'pipeline': pipeline}
+             'expert': expert, 'pipeline': pipeline, 'dcn': dcn}
     fixed = {k: v for k, v in known.items() if v is not None}
     prod = math.prod(fixed.values()) if fixed else 1
     if n_devices % max(prod, 1) != 0:
@@ -94,7 +116,8 @@ def plan_mesh(n_devices: int,
                     fsdp=fixed.get('fsdp', 1),
                     tensor=fixed.get('tensor', 1),
                     expert=fixed.get('expert', 1),
-                    pipeline=fixed.get('pipeline', 1))
+                    pipeline=fixed.get('pipeline', 1),
+                    dcn=fixed.get('dcn', 1))
     plan.validate(n_devices)
     return plan
 
@@ -110,7 +133,10 @@ def build_mesh(plan: Optional[MeshPlan] = None,
         plan = plan_mesh(len(devices))
     plan.validate(len(devices))
     import numpy as np
-    dev_array = np.array(devices).reshape(plan.pipeline, plan.data,
-                                          plan.fsdp, plan.expert,
-                                          plan.tensor)
+    # dcn outermost: jax.devices() enumerates slice 0's devices first, so
+    # splitting on the leading axis puts each slice's devices into one dcn
+    # coordinate — per-slice axes stay on ICI, only dcn crosses slices.
+    dev_array = np.array(devices).reshape(plan.dcn, plan.pipeline,
+                                          plan.data, plan.fsdp,
+                                          plan.expert, plan.tensor)
     return Mesh(dev_array, MESH_AXES)

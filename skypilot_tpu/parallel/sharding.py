@@ -17,7 +17,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 # shard over tensor; 'batch' over (data, fsdp); 'seq' over fsdp for
 # context parallelism (ring attention).
 DEFAULT_RULES: Tuple[Tuple[str, Optional[object]], ...] = (
-    ('batch', ('data', 'fsdp', 'expert')),
+    # 'dcn' leads the batch group: on multislice clusters the batch is
+    # split across slices first (pure DP over DCN — gradient all-reduce
+    # is the only collective that crosses the inter-slice network).
+    ('batch', ('dcn', 'data', 'fsdp', 'expert')),
     ('seq', None),
     ('embed', 'fsdp'),
     ('mlp', 'tensor'),
@@ -63,6 +66,6 @@ def tree_shardings(mesh: Mesh, logical_tree,
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Sharding for (batch, ...) input arrays: batch over
-    data+fsdp+expert (the expert axis doubles as data parallelism in
-    non-MoE layers)."""
-    return NamedSharding(mesh, P(('data', 'fsdp', 'expert')))
+    dcn+data+fsdp+expert (dcn = inter-slice DP on multislice clusters;
+    the expert axis doubles as data parallelism in non-MoE layers)."""
+    return NamedSharding(mesh, P(('dcn', 'data', 'fsdp', 'expert')))

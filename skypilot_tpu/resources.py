@@ -198,6 +198,15 @@ class Resources:
         return tpu.num_hosts if tpu is not None else 1
 
     @property
+    def num_slices(self) -> int:
+        """Multislice fan-out: ``tpu-v5e-64x2`` provisions 2 slices as ONE
+        cluster (each slice is one provisioning node); the gang executor
+        wires them over DCN via the MEGASCALE env contract.  1 for
+        single-slice and non-TPU resources."""
+        tpu = self.tpu
+        return tpu.num_slices if tpu is not None else 1
+
+    @property
     def tpu_runtime_version(self) -> Optional[str]:
         if self.runtime_version is not None:
             return self.runtime_version

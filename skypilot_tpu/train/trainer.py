@@ -103,7 +103,7 @@ def make_sharded_train_step(
     loss_fn: Callable[[jax.Array, jax.Array], jax.Array] = lm_loss,
 ) -> Callable[[TrainState, jax.Array], Tuple[TrainState, dict]]:
     """Jitted train step: donated state in, sharded state out."""
-    batch_sharding = NamedSharding(mesh, P(('data', 'fsdp', 'expert')))
+    batch_sharding = sharding_lib.batch_sharding(mesh)
 
     def step(state: TrainState, tokens: jax.Array):
         def compute_loss(params):

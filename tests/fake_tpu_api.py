@@ -154,6 +154,17 @@ class FakeTpuApi:
                         return self._error(
                             429, 'There is no more capacity in the zone; '
                             'RESOURCE_EXHAUSTED')
+                    if behavior == 'stockout_after_1':
+                        # First create succeeds, later ones stockout —
+                        # the partial-multislice scenario (slice 0 lands,
+                        # slice 1 doesn't; provisioning must clean up
+                        # atomically and fail over).
+                        with state.lock:
+                            n_created = sum(
+                                1 for k in state.nodes
+                                if k.startswith(f'{zone}/'))
+                        if n_created >= 1:
+                            return self._error(429, 'RESOURCE_EXHAUSTED')
                     if behavior == 'quota':
                         return self._error(
                             403, 'Quota exceeded for quota metric '

@@ -1,0 +1,269 @@
+"""Multislice (DCN-connected N-slice) clusters.
+
+Covers the full multislice contract end to end at unit level:
+- ``tpu-v5e-8x2`` accelerator sugar (accelerators.py) and 2x pricing;
+- the per-host MEGASCALE_* / TPU_WORKER_* env the gang executor injects
+  (agent/gang.py + parallel/distributed.py) — env analog of the reference's
+  per-node env plumbing, sky/skylet/constants.py:445-450;
+- the ``dcn`` mesh axis (parallel/mesh.py): data parallelism spans slices,
+  fsdp/tensor stay intra-slice, batch shardings pick the axis up;
+- fake-TPU-API provisioning: N slices as one atomic placement that cleans
+  up partial slices and fails over as a unit.
+"""
+import jax
+import pytest
+
+from skypilot_tpu import accelerators as acc_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import provision
+from skypilot_tpu.agent import gang as gang_lib
+from skypilot_tpu.catalog import gcp_catalog
+from skypilot_tpu.parallel import distributed
+from skypilot_tpu.parallel.mesh import build_mesh, plan_mesh
+from skypilot_tpu.parallel.sharding import batch_sharding, logical_to_spec
+from skypilot_tpu.provision import failover
+from skypilot_tpu.provision.common import InstanceStatus, ProvisionConfig
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.task import Task
+
+from tests.test_provision import fake_tpu  # noqa: F401  (fixture)
+
+
+# ----- accelerator sugar -----------------------------------------------------
+def test_parse_multislice_suffix():
+    t = acc_lib.parse_tpu('tpu-v5e-8x2')
+    assert t.num_slices == 2
+    assert t.num_chips == 8            # per slice
+    assert t.slice_name == 'tpu-v5litepod-8'
+    assert t.gcp_accelerator_type == 'v5litepod-8'
+    # name round-trips through parse_tpu
+    assert acc_lib.parse_tpu(t.name) == t
+
+
+def test_parse_single_slice_unchanged():
+    t = acc_lib.parse_tpu('tpu-v5e-8')
+    assert t.num_slices == 1
+    assert t.name == 'tpu-v5litepod-8'
+    assert 'x' not in t.name
+
+
+def test_multislice_resources_and_pricing():
+    res = Resources.from_yaml_config({'accelerators': 'tpu-v5e-8x2',
+                                      'infra': 'gcp'})
+    assert res.num_slices == 2
+    assert res.hosts_per_node == 1      # per slice: v5e-8 is single-host
+    single = gcp_catalog.get_tpu_hourly_cost('tpu-v5e-8')
+    double = gcp_catalog.get_tpu_hourly_cost('tpu-v5e-8x2')
+    assert double == pytest.approx(2 * single)
+
+
+def test_multislice_zero_invalid():
+    with pytest.raises(exceptions.InvalidAcceleratorError):
+        acc_lib.parse_tpu('tpu-v5e-8x0')
+
+
+# ----- gang env contract -----------------------------------------------------
+def test_megascale_env_per_slice_host():
+    # 2 slices x 2 hosts; global host ranks enumerate slice 0 first.
+    slices = [['10.0.0.1', '10.0.0.2'], ['10.0.1.1', '10.0.1.2']]
+    flat = [ip for s in slices for ip in s]
+    for rank, (want_slice, want_worker) in enumerate(
+            [(0, 0), (0, 1), (1, 0), (1, 1)]):
+        env = gang_lib.build_host_env(flat, rank, chips_per_host=4,
+                                      slice_ips=slices)
+        # SKYTPU_* wiring spans ALL hosts of all slices (one
+        # jax.distributed world).
+        assert env['SKYTPU_NUM_NODES'] == '4'
+        assert env['SKYTPU_NODE_RANK'] == str(rank)
+        assert env['SKYTPU_COORDINATOR_ADDR'].startswith('10.0.0.1:')
+        # MEGASCALE contract: coordinator is slice-0 host-0; slice id and
+        # in-slice worker id follow the host's position.
+        assert env['MEGASCALE_NUM_SLICES'] == '2'
+        assert env['MEGASCALE_SLICE_ID'] == str(want_slice)
+        assert env['MEGASCALE_COORDINATOR_ADDRESS'] == (
+            f'10.0.0.1:{distributed.DEFAULT_MEGASCALE_PORT}')
+        assert env['TPU_WORKER_ID'] == str(want_worker)
+        assert env['TPU_WORKER_HOSTNAMES'] == ','.join(slices[want_slice])
+        assert env['SKYTPU_NUM_SLICES'] == '2'
+        assert env['SKYTPU_SLICE_ID'] == str(want_slice)
+
+
+def test_no_megascale_env_single_slice():
+    env = gang_lib.build_host_env(['10.0.0.1', '10.0.0.2'], 0,
+                                  chips_per_host=4,
+                                  slice_ips=[['10.0.0.1', '10.0.0.2']])
+    assert not any(k.startswith('MEGASCALE') for k in env)
+    assert 'TPU_WORKER_ID' not in env
+
+
+def test_gang_no_megascale_without_explicit_multislice(tmp_path):
+    """num_nodes>1 of a plain (non-xN) TPU resource = N INDEPENDENT
+    slices: the gang must NOT inject MEGASCALE (libtpu would otherwise
+    force DCN mesh bring-up on jobs that never asked for it)."""
+    out = tmp_path / 'env'
+    out.mkdir()
+    spec = {
+        'nodes': [['127.0.0.1'], ['localhost']],   # no num_slices
+        'chips_per_host': 4,
+        'is_local': True,
+        'run': (f'env | grep -c MEGASCALE > {out}/$SKYTPU_NODE_RANK.txt; '
+                f'true'),
+    }
+    job = gang_lib.GangJob(1, spec, str(tmp_path / 'logs'))
+    rc = gang_lib.run_gang_job(1, spec, str(tmp_path / 'logs'),
+                               lambda *a: None, job=job)
+    assert rc == 0
+    assert (out / '0.txt').read_text().strip() == '0'
+    assert (out / '1.txt').read_text().strip() == '0'
+
+
+def test_no_megascale_env_cpu_nodes():
+    # Two non-TPU nodes (chips=0): plain distributed wiring only.
+    env = gang_lib.build_host_env(['10.0.0.1', '10.0.0.2'], 1,
+                                  chips_per_host=0,
+                                  slice_ips=[['10.0.0.1'], ['10.0.0.2']])
+    assert not any(k.startswith('MEGASCALE') for k in env)
+
+
+def test_gang_fan_out_injects_megascale(tmp_path):
+    """The run phase of a 2-slice gang carries the MEGASCALE env into the
+    spawned processes (captured via the process environment itself)."""
+    out = tmp_path / 'env'
+    out.mkdir()
+    spec = {
+        'nodes': [['127.0.0.1'], ['localhost']],
+        'num_slices': 2,
+        'chips_per_host': 4,
+        'is_local': True,
+        'run': (f'env | grep -E "MEGASCALE|SKYTPU_SLICE" > '
+                f'{out}/$SKYTPU_NODE_RANK.txt'),
+    }
+    job = gang_lib.GangJob(1, spec, str(tmp_path / 'logs'))
+    rc = gang_lib.run_gang_job(1, spec, str(tmp_path / 'logs'),
+                               lambda *a: None, job=job)
+    assert rc == 0
+    env0 = (out / '0.txt').read_text()
+    env1 = (out / '1.txt').read_text()
+    assert 'MEGASCALE_SLICE_ID=0' in env0
+    assert 'MEGASCALE_SLICE_ID=1' in env1
+    for blob in (env0, env1):
+        assert 'MEGASCALE_NUM_SLICES=2' in blob
+        assert f'MEGASCALE_COORDINATOR_ADDRESS=127.0.0.1:'\
+               f'{distributed.DEFAULT_MEGASCALE_PORT}' in blob
+
+
+# ----- dcn mesh axis ---------------------------------------------------------
+def test_plan_mesh_dcn_axis():
+    plan = plan_mesh(8, dcn=2, tensor=2)
+    assert plan.dcn == 2 and plan.tensor == 2 and plan.fsdp == 2
+    assert plan.num_devices == 8
+    mesh = build_mesh(plan, jax.devices()[:8])
+    assert mesh.shape['dcn'] == 2
+    # slice locality: dcn is outermost, so each dcn coordinate holds one
+    # contiguous half of the device order (= one slice's devices).
+    devs = mesh.devices
+    first_slice = set(d.id for d in devs[0].flatten())
+    assert first_slice == set(range(4))
+
+
+def test_plan_mesh_dcn_defaults_from_env(monkeypatch):
+    """User code on a multislice cluster calls plan_mesh(device_count)
+    with no args: the dcn axis comes from the gang-injected
+    SKYTPU_NUM_SLICES, so fsdp all-gathers never span the DCN boundary
+    silently."""
+    monkeypatch.setenv('SKYTPU_NUM_SLICES', '2')
+    plan = plan_mesh(8)
+    assert plan.dcn == 2 and plan.fsdp == 4
+    monkeypatch.setenv('SKYTPU_NUM_SLICES', '3')
+    with pytest.raises(ValueError, match='does not divide'):
+        plan_mesh(8)
+    # explicit dcn wins over env
+    assert plan_mesh(8, dcn=1).dcn == 1
+
+
+def test_batch_shardings_span_dcn():
+    assert 'dcn' in batch_sharding(
+        build_mesh(plan_mesh(8, dcn=2), jax.devices()[:8])).spec[0]
+    assert 'dcn' in logical_to_spec(('batch',))[0]
+
+
+def test_train_step_over_dcn_mesh():
+    """One sharded train step on a dcn=2 x fsdp=2 x tensor=2 mesh — the
+    multislice training topology, on the virtual 8-device CPU mesh."""
+    from skypilot_tpu.models.llama import Llama, LLAMA_CONFIGS
+    from skypilot_tpu.train.trainer import TrainConfig, Trainer
+    mesh = build_mesh(plan_mesh(8, dcn=2, fsdp=2, tensor=2),
+                      jax.devices()[:8])
+    cfg = LLAMA_CONFIGS['tiny']
+    rng = jax.random.PRNGKey(0)
+    tokens = jax.random.randint(rng, (4, 32), 0, cfg.vocab_size)
+    trainer = Trainer(Llama(cfg, mesh), mesh, rng, tokens,
+                      TrainConfig(warmup_steps=1, total_steps=2))
+    _, metrics = trainer.train_step(trainer.state, tokens)
+    assert float(jax.device_get(metrics['loss'])) > 0
+
+
+# ----- provisioning ----------------------------------------------------------
+def _multislice_task(acc='tpu-v5e-8x2', infra='gcp/us-east5'):
+    t = Task('train', run='echo hi')
+    t.set_resources(Resources.from_yaml_config(
+        {'accelerators': acc, 'infra': infra}))
+    return t
+
+
+def _provision_fn_for(task, cluster_name):
+    """Mirror of the backend's provision_fn (tpu_vm_backend.py:
+    _provision_locked): one provisioning node per slice."""
+    def provision_fn(candidate):
+        config = ProvisionConfig(
+            cluster_name=cluster_name,
+            num_nodes=task.num_nodes * candidate.num_slices,
+            resources_config=candidate.to_yaml_config(),
+            region=candidate.region, zone=candidate.zone)
+        record = provision.run_instances(candidate.cloud, config)
+        provision.wait_instances(candidate.cloud, cluster_name,
+                                 region=record.region, zone=record.zone,
+                                 timeout_s=30)
+        return record
+
+    def cleanup_fn(candidate):
+        provision.terminate_instances(candidate.cloud, cluster_name,
+                                      region=candidate.region,
+                                      zone=candidate.zone)
+    return provision_fn, cleanup_fn
+
+
+def test_two_slice_cluster_provisions(fake_tpu, tmp_home):  # noqa: F811
+    config = ProvisionConfig(
+        cluster_name='ms', num_nodes=2,
+        resources_config={'accelerators': 'tpu-v5e-8x2',
+                          'infra': 'gcp/us-east5/us-east5-a'},
+        region='us-east5', zone='us-east5-a')
+    record = provision.run_instances('gcp', config)
+    assert record.instance_ids == ['ms-0', 'ms-1']
+    provision.wait_instances('gcp', 'ms', zone='us-east5-a', timeout_s=30)
+    for node_id in ('ms-0', 'ms-1'):
+        node = fake_tpu.node('us-east5-a', node_id)
+        assert node['acceleratorType'] == 'v5litepod-8'   # per-slice shape
+    info = provision.get_cluster_info('gcp', 'ms', zone='us-east5-a')
+    assert len(info.instances) == 2
+
+
+def test_partial_multislice_fails_over_atomically(fake_tpu,  # noqa: F811
+                                                  enable_all_clouds,
+                                                  tmp_home):
+    """Slice 0 lands in the first zone but slice 1 stocks out: the failed
+    zone must be cleaned to zero nodes (no orphaned slice burning quota)
+    and BOTH slices must land together in the next zone."""
+    fake_tpu.set_zone_behavior('us-east5-a', 'stockout_after_1')
+    task = _multislice_task()
+    provision_fn, cleanup_fn = _provision_fn_for(task, 'msf')
+    result = failover.provision_with_retries(
+        task, 'msf', provision_fn, cleanup_fn=cleanup_fn)
+    assert result.record.zone == 'us-east5-b'
+    # Atomic: nothing left behind in the stocked-out zone.
+    assert provision.query_instances('gcp', 'msf',
+                                     zone='us-east5-a') == {}
+    statuses = provision.query_instances('gcp', 'msf', zone='us-east5-b')
+    assert statuses == {'msf-0': InstanceStatus.RUNNING,
+                        'msf-1': InstanceStatus.RUNNING}
