@@ -31,8 +31,19 @@ def auth_headers() -> Dict[str, str]:
             creds, _ = google.auth.default(scopes=_SCOPES)
             creds.refresh(google.auth.transport.requests.Request())
             _token = creds.token
-            # ADC tokens live ~3600s; refresh with headroom.
-            _token_expiry = time.time() + 3000
+            # Trust the credential's own expiry when it reports one
+            # (impersonated service accounts / workload identity can be
+            # much shorter than ADC's ~3600s); fall back to a fixed
+            # headroom only when it is unknown.
+            expiry = getattr(creds, 'expiry', None)
+            if expiry is not None:
+                # google-auth expiry is a NAIVE datetime in UTC.
+                from datetime import timezone
+                if expiry.tzinfo is None:
+                    expiry = expiry.replace(tzinfo=timezone.utc)
+                _token_expiry = expiry.timestamp()
+            else:
+                _token_expiry = time.time() + 3000
         return {'Authorization': f'Bearer {_token}'}
 
 

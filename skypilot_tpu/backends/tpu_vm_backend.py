@@ -541,9 +541,9 @@ class TpuVmBackend(backend_lib.Backend):
             client.close()
 
     def tail_logs(self, handle: ClusterHandle, job_id: int,
-                  follow: bool = True) -> int:
+                  follow: bool = True, out=None) -> int:
         client = self._agent_client(handle)
         try:
-            return client.tail_logs(job_id, follow=follow)
+            return client.tail_logs(job_id, follow=follow, out=out)
         finally:
             client.close()

@@ -27,6 +27,9 @@ def get_hourly_cost(resources: 'resources_lib.Resources') -> float:
     cloud = resources.cloud
     if cloud == 'local':
         return 0.0
+    if cloud == 'aws':
+        from skypilot_tpu import clouds as clouds_lib
+        return clouds_lib.get_cloud('aws').hourly_cost(resources)
     if resources.is_tpu:
         tpu = resources.tpu
         assert tpu is not None

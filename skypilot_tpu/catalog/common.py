@@ -39,8 +39,9 @@ except ValueError:
 # Per-catalog refresh remediation (only files a fetcher actually
 # regenerates may point at that fetcher).
 _REFRESH_HINTS = {
-    'gcp_tpus.csv': ('python -m skypilot_tpu.catalog.data_fetchers'
-                     '.fetch_gcp'),
+    'gcp_tpus.csv': '`skytpu catalog refresh` (or python -m '
+                    'skypilot_tpu.catalog.data_fetchers.fetch_gcp)',
+    'gcp_vms.csv': '`skytpu catalog refresh`',
 }
 
 

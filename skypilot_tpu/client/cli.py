@@ -226,7 +226,10 @@ def accelerators(name_filter):
 @cli.command()
 def check():
     """Check cloud credentials and catalog freshness."""
-    for name, info in sdk.check().items():
+    result = sdk.check()
+    for warning in result.pop('_warnings', []):
+        click.secho(f'  WARNING: {warning}', fg='yellow', err=True)
+    for name, info in result.items():
         mark = 'enabled' if info['enabled'] else \
             f'disabled ({info["reason"]})'
         storage = info.get('storage')
@@ -241,6 +244,56 @@ def check():
                  f'{age}d old' + (' — STALE, refresh with '
                                   'data_fetchers' if st['stale'] else ''))
         click.echo(f'  catalog {fn}: {state}')
+
+
+@cli.command('plan')
+@click.option('--accelerator', required=True,
+              help='Target slice, e.g. tpu-v5p-256 (xN for multislice).')
+@click.option('--model', 'model_name', default='llama3-8b',
+              help='Model to place (models/llama.py LLAMA_CONFIGS key).')
+@click.option('--batch', default=8, type=int)
+@click.option('--seq', default=2048, type=int)
+@click.option('--data', type=int, default=None)
+@click.option('--fsdp', type=int, default=None)
+@click.option('--tensor', type=int, default=None)
+@click.option('--compile', 'do_compile', is_flag=True,
+              help='Run the real TPU compiler against the abstract '
+                   'topology (exact temps + remat warnings; slower).')
+def plan(accelerator, model_name, batch, seq, data, fsdp, tensor,
+         do_compile):
+    """Validate a training placement BEFORE spending quota.
+
+    AOT-lowers the sharded train step against a topology description of
+    the target slice (no hardware needed) and reports the per-device HBM
+    footprint; exits non-zero when the plan does not fit."""
+    from skypilot_tpu.parallel import validate as validate_lib
+    report = validate_lib.validate_placement(
+        accelerator, model_name=model_name, batch=batch, seq=seq,
+        data=data, fsdp=fsdp, tensor=tensor, compile=do_compile)
+    click.echo(report.summary())
+    if not report.fits:
+        raise SystemExit(1)
+
+
+@cli.group()
+def catalog():
+    """Pricing-catalog maintenance."""
+
+
+@catalog.command('refresh')
+def catalog_refresh():
+    """Regenerate the GCP catalogs from the Cloud Billing API.
+
+    Runs the data fetcher (catalog/data_fetchers/fetch_gcp.py) locally:
+    refreshed CSVs land in ~/.skytpu/catalogs/ and take precedence over
+    the bundled copies; `skytpu check` reports their age.  Requires GCP
+    credentials + google-api-python-client (or a recorded fixture via
+    SKYTPU_BILLING_FIXTURE)."""
+    from skypilot_tpu.catalog.data_fetchers import fetch_gcp
+    rc = fetch_gcp.main()
+    if rc != 0:
+        raise SystemExit(rc)
+    click.echo('Catalogs refreshed; `skytpu check` shows their age.')
 
 
 @cli.group()

@@ -312,7 +312,9 @@ def accelerators(name_filter: Optional[str] = None) -> Dict[str, Any]:
 
 
 def check() -> Dict[str, Any]:
-    return _get('/check')
+    # warnings=1: this client understands the reserved '_warnings' key
+    # (older servers simply ignore the param).
+    return _get('/check', warnings='1')
 
 
 def catalog_staleness() -> Dict[str, Any]:

@@ -4,17 +4,20 @@ from __future__ import annotations
 from typing import Dict, List, Optional
 
 from skypilot_tpu import exceptions
+from skypilot_tpu.clouds.aws import AWS
 from skypilot_tpu.clouds.cloud import Cloud, CloudCapability
 from skypilot_tpu.clouds.gcp import GCP
 from skypilot_tpu.clouds.kubernetes import Kubernetes
 from skypilot_tpu.clouds.local import Local
 from skypilot_tpu.clouds.ssh import SSH
 
-__all__ = ['Cloud', 'CloudCapability', 'GCP', 'Kubernetes', 'Local',
-           'SSH', 'get_cloud', 'enabled_clouds', 'CLOUD_REGISTRY']
+__all__ = ['AWS', 'Cloud', 'CloudCapability', 'GCP', 'Kubernetes',
+           'Local', 'SSH', 'get_cloud', 'enabled_clouds',
+           'CLOUD_REGISTRY']
 
 CLOUD_REGISTRY: Dict[str, Cloud] = {
     GCP.NAME: GCP(),
+    AWS.NAME: AWS(),
     Kubernetes.NAME: Kubernetes(),
     Local.NAME: Local(),
     SSH.NAME: SSH(),

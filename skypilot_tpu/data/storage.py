@@ -38,6 +38,11 @@ def _fake_root() -> Optional[str]:
     return os.path.expanduser(root) if root else None
 
 
+def _fake_s3_root() -> Optional[str]:
+    root = os.environ.get('SKYTPU_FAKE_S3_ROOT')
+    return os.path.expanduser(root) if root else None
+
+
 class StoreType(enum.Enum):
     GCS = 'gcs'
     S3 = 's3'
@@ -81,16 +86,29 @@ class StorageMount:
         if not source and not name:
             raise exceptions.StorageError(
                 f'storage mount {mount_path!r} needs "source" or "name"')
+        store = config.get('store')
+        if store is not None:
+            store = str(store).lower()
+            try:
+                StoreType(store)
+            except ValueError:
+                raise exceptions.StorageError(
+                    f'storage mount {mount_path!r}: unknown store '
+                    f'{config.get("store")!r}; expected one of '
+                    f'{[s.value for s in StoreType]}') from None
         return cls(
             mount_path=mount_path,
             source=source,
             mode=StorageMode(config.get('mode', 'MOUNT').upper()),
             name=name,
+            store=store,
         )
+
+    store: Optional[str] = None      # 'gcs' (default) or 's3' for name-d
 
     def materialize(self) -> str:
         """Ensure the backing bucket exists (creating/uploading for
-        name-managed mounts); returns the gs:// URL to mount/copy."""
+        name-managed mounts); returns the bucket URL to mount/copy."""
         if self.source.startswith(('gs://', 's3://', 'r2://')):
             return self.source
         if self.name is None:
@@ -99,15 +117,20 @@ class StorageMount:
                 f'({self.source!r}) needs "name" for the bucket to '
                 'upload into')
         local_source = self.source or None
-        Storage(self.name, source=local_source).materialize()
-        return f'gs://{self.name}'
+        store_type = StoreType(self.store) if self.store else StoreType.GCS
+        Storage(self.name, source=local_source,
+                store=store_type).materialize()
+        scheme = 's3' if store_type is StoreType.S3 else 'gs'
+        return f'{scheme}://{self.name}'
 
 
-class GcsStore:
-    """GCS bucket lifecycle + sync (parity: sky/data/storage.py GcsStore
-    :2149 create/delete/upload).  Real path drives gsutil; with
-    SKYTPU_FAKE_GCS_ROOT every op is a local file op on
-    `$ROOT/<bucket>/` (see module docstring)."""
+class _BucketStore:
+    """Shared bucket-store skeleton: fake-root file ops (the hermetic
+    test boundary) live here once; subclasses supply the scheme, the
+    fake-root env, and the provider-CLI verbs (parity: the reference's
+    AbstractStore, sky/data/storage.py:320)."""
+
+    SCHEME = ''
 
     def __init__(self, bucket: str) -> None:
         if '/' in bucket:
@@ -117,51 +140,62 @@ class GcsStore:
 
     @property
     def url(self) -> str:
-        return f'gs://{self.bucket}'
+        return f'{self.SCHEME}://{self.bucket}'
 
+    # subclass hooks ----------------------------------------------------------
+    def _fake(self) -> Optional[str]:
+        raise NotImplementedError
+
+    def _real_exists(self) -> bool:
+        raise NotImplementedError
+
+    def _real_create(self, region: Optional[str]) -> None:
+        raise NotImplementedError
+
+    def _real_delete(self) -> None:
+        raise NotImplementedError
+
+    def _real_sync_up(self, src_dir: str, prefix: str,
+                      excludes: List[str]) -> None:
+        raise NotImplementedError
+
+    def _real_sync_down(self, local_dir: str, prefix: str) -> None:
+        raise NotImplementedError
+
+    def _real_list_prefix(self, prefix: str) -> List[str]:
+        raise NotImplementedError
+
+    # shared ------------------------------------------------------------------
     def _local(self, prefix: str = '') -> str:
-        root = _fake_root()
+        root = self._fake()
         assert root is not None
         return os.path.join(root, self.bucket, prefix.lstrip('/'))
 
-    def _gsutil(self, *args: str) -> subprocess.CompletedProcess:
-        return subprocess.run(['gsutil', '-m', *args], check=False,
-                              capture_output=True, text=True)
+    def _url_prefix(self, prefix: str) -> str:
+        return f'{self.url}/{prefix}'.rstrip('/')
 
-    # ----- lifecycle ---------------------------------------------------------
     def exists(self) -> bool:
-        if _fake_root():
+        if self._fake():
             return os.path.isdir(self._local())
-        return self._gsutil('ls', '-b', self.url).returncode == 0
+        return self._real_exists()
 
     def create(self, region: Optional[str] = None) -> None:
-        if _fake_root():
+        if self._fake():
             os.makedirs(self._local(), exist_ok=True)
             return
-        args = ['mb']
-        if region:
-            args += ['-l', region]
-        res = self._gsutil(*args, self.url)
-        if res.returncode != 0 and 'already' not in res.stderr.lower():
-            raise exceptions.StorageError(
-                f'failed to create {self.url}: {res.stderr.strip()}')
+        self._real_create(region)
 
     def delete(self) -> None:
-        if _fake_root():
+        if self._fake():
             shutil.rmtree(self._local(), ignore_errors=True)
             return
-        res = self._gsutil('rm', '-r', self.url)
-        if res.returncode != 0 and 'bucketnotfound' not in \
-                res.stderr.lower().replace(' ', ''):
-            raise exceptions.StorageError(
-                f'failed to delete {self.url}: {res.stderr.strip()}')
+        self._real_delete()
 
-    # ----- data --------------------------------------------------------------
     def sync_up(self, src_dir: str, prefix: str = '') -> None:
         """Upload a directory, honoring `.skyignore` at its root."""
         src_dir = os.path.expanduser(src_dir)
         excludes = storage_utils.load_excludes(src_dir)
-        if _fake_root():
+        if self._fake():
             dst = self._local(prefix)
             for dirpath, _dirnames, filenames in os.walk(src_dir):
                 for fname in filenames:
@@ -174,32 +208,20 @@ class GcsStore:
                     os.makedirs(os.path.dirname(target), exist_ok=True)
                     shutil.copy2(full, target)
             return
-        args = ['rsync', '-r']
-        if excludes:
-            # gsutil honors a single -x; OR the patterns into one regex.
-            args += ['-x', '|'.join(fnmatch_to_re(p) for p in excludes)]
-        res = self._gsutil(*args, src_dir,
-                           f'{self.url}/{prefix}'.rstrip('/'))
-        if res.returncode != 0:
-            raise exceptions.StorageError(
-                f'sync_up to {self.url} failed: {res.stderr.strip()}')
+        self._real_sync_up(src_dir, prefix, excludes)
 
     def sync_down(self, local_dir: str, prefix: str = '') -> None:
         local_dir = os.path.expanduser(local_dir)
         os.makedirs(local_dir, exist_ok=True)
-        if _fake_root():
+        if self._fake():
             src = self._local(prefix)
             if os.path.isdir(src):
                 shutil.copytree(src, local_dir, dirs_exist_ok=True)
             return
-        res = self._gsutil('rsync', '-r',
-                           f'{self.url}/{prefix}'.rstrip('/'), local_dir)
-        if res.returncode != 0:
-            raise exceptions.StorageError(
-                f'sync_down from {self.url} failed: {res.stderr.strip()}')
+        self._real_sync_down(local_dir, prefix)
 
     def list_prefix(self, prefix: str = '') -> List[str]:
-        if _fake_root():
+        if self._fake():
             base = self._local(prefix)
             out = []
             for dirpath, _d, filenames in os.walk(base):
@@ -208,8 +230,63 @@ class GcsStore:
                                           self._local())
                     out.append(rel.replace(os.sep, '/'))
             return sorted(out)
-        res = self._gsutil('ls', '-r',
-                           f'{self.url}/{prefix}'.rstrip('/'))
+        return self._real_list_prefix(prefix)
+
+
+class GcsStore(_BucketStore):
+    """GCS bucket lifecycle + sync (parity: sky/data/storage.py GcsStore
+    :2149 create/delete/upload).  Real path drives gsutil; with
+    SKYTPU_FAKE_GCS_ROOT every op is a local file op on
+    `$ROOT/<bucket>/` (see module docstring)."""
+
+    SCHEME = 'gs'
+
+    def _fake(self) -> Optional[str]:
+        return _fake_root()
+
+    def _gsutil(self, *args: str) -> subprocess.CompletedProcess:
+        return subprocess.run(['gsutil', '-m', *args], check=False,
+                              capture_output=True, text=True)
+
+    def _real_exists(self) -> bool:
+        return self._gsutil('ls', '-b', self.url).returncode == 0
+
+    def _real_create(self, region: Optional[str]) -> None:
+        args = ['mb']
+        if region:
+            args += ['-l', region]
+        res = self._gsutil(*args, self.url)
+        if res.returncode != 0 and 'already' not in res.stderr.lower():
+            raise exceptions.StorageError(
+                f'failed to create {self.url}: {res.stderr.strip()}')
+
+    def _real_delete(self) -> None:
+        res = self._gsutil('rm', '-r', self.url)
+        if res.returncode != 0 and 'bucketnotfound' not in \
+                res.stderr.lower().replace(' ', ''):
+            raise exceptions.StorageError(
+                f'failed to delete {self.url}: {res.stderr.strip()}')
+
+    def _real_sync_up(self, src_dir: str, prefix: str,
+                      excludes: List[str]) -> None:
+        args = ['rsync', '-r']
+        if excludes:
+            # gsutil honors a single -x; OR the patterns into one regex.
+            args += ['-x', '|'.join(fnmatch_to_re(p) for p in excludes)]
+        res = self._gsutil(*args, src_dir, self._url_prefix(prefix))
+        if res.returncode != 0:
+            raise exceptions.StorageError(
+                f'sync_up to {self.url} failed: {res.stderr.strip()}')
+
+    def _real_sync_down(self, local_dir: str, prefix: str) -> None:
+        res = self._gsutil('rsync', '-r', self._url_prefix(prefix),
+                           local_dir)
+        if res.returncode != 0:
+            raise exceptions.StorageError(
+                f'sync_down from {self.url} failed: {res.stderr.strip()}')
+
+    def _real_list_prefix(self, prefix: str) -> List[str]:
+        res = self._gsutil('ls', '-r', self._url_prefix(prefix))
         if res.returncode != 0:
             return []
         marker = f'{self.url}/'
@@ -225,6 +302,78 @@ def fnmatch_to_re(pattern: str) -> str:
     return fnmatch_lib.translate(pattern)
 
 
+class S3Store(_BucketStore):
+    """S3 bucket lifecycle + sync (parity: sky/data/storage.py S3Store
+    :4502).  Real path drives the `aws s3` CLI (same CLI-driven shape as
+    GcsStore/gsutil); with SKYTPU_FAKE_S3_ROOT every op is a local file
+    op on `$ROOT/<bucket>/` — the same hermetic boundary the GCS fake
+    provides."""
+
+    SCHEME = 's3'
+
+    def _fake(self) -> Optional[str]:
+        return _fake_s3_root()
+
+    def _aws(self, *args: str) -> subprocess.CompletedProcess:
+        return subprocess.run(['aws', 's3', *args], check=False,
+                              capture_output=True, text=True)
+
+    def _real_exists(self) -> bool:
+        return self._aws('ls', self.url).returncode == 0
+
+    def _real_create(self, region: Optional[str]) -> None:
+        args = ['mb', self.url]
+        if region:
+            args += ['--region', region]
+        res = self._aws(*args)
+        if res.returncode != 0 and 'alreadyownedbyyou' not in \
+                res.stderr.lower().replace(' ', ''):
+            raise exceptions.StorageError(
+                f'failed to create {self.url}: {res.stderr.strip()}')
+
+    def _real_delete(self) -> None:
+        res = self._aws('rb', self.url, '--force')
+        if res.returncode != 0 and 'nosuchbucket' not in \
+                res.stderr.lower().replace(' ', ''):
+            raise exceptions.StorageError(
+                f'failed to delete {self.url}: {res.stderr.strip()}')
+
+    def _real_sync_up(self, src_dir: str, prefix: str,
+                      excludes: List[str]) -> None:
+        args = ['sync', src_dir, self._url_prefix(prefix)]
+        for pat in excludes:                 # aws s3 takes globs directly
+            args += ['--exclude', pat]
+        res = self._aws(*args)
+        if res.returncode != 0:
+            raise exceptions.StorageError(
+                f'sync_up to {self.url} failed: {res.stderr.strip()}')
+
+    def _real_sync_down(self, local_dir: str, prefix: str) -> None:
+        res = self._aws('sync', self._url_prefix(prefix), local_dir)
+        if res.returncode != 0:
+            raise exceptions.StorageError(
+                f'sync_down from {self.url} failed: {res.stderr.strip()}')
+
+    def _real_list_prefix(self, prefix: str) -> List[str]:
+        res = self._aws('ls', '--recursive', self._url_prefix(prefix))
+        if res.returncode != 0:
+            return []
+        return sorted(line.split(None, 3)[3]
+                      for line in res.stdout.splitlines()
+                      if len(line.split(None, 3)) == 4)
+
+
+def store_for_url(url: str):
+    """gs://b -> GcsStore('b'), s3://b -> S3Store('b')."""
+    store_type = StoreType.from_url(url)
+    bucket = url.split('://', 1)[1].split('/', 1)[0]
+    if store_type is StoreType.GCS:
+        return GcsStore(bucket)
+    if store_type is StoreType.S3:
+        return S3Store(bucket)
+    raise exceptions.StorageError(f'No store backend for {url}')
+
+
 @dataclasses.dataclass
 class Storage:
     """User-facing storage object: a (possibly framework-created) bucket
@@ -232,9 +381,11 @@ class Storage:
     name: str                                   # bucket name
     source: Optional[str] = None                # local dir to upload
     persistent: bool = True                     # survive `storage delete`?
+    store: StoreType = StoreType.GCS            # backing provider
 
-    def materialize(self) -> GcsStore:
-        store = GcsStore(self.name)
+    def materialize(self):
+        store = (S3Store(self.name) if self.store is StoreType.S3
+                 else GcsStore(self.name))
         if not store.exists():
             store.create()
         if self.source:
@@ -255,6 +406,11 @@ def copy_command(source: str, dst: str) -> str:
         return (f'mkdir -p {q(dst)} && '
                 f'gsutil -m rsync -r {q(source)} {q(dst)}')
     if store is StoreType.S3:
+        root = _fake_s3_root()
+        if root is not None:
+            src = os.path.join(root, source[len('s3://'):])
+            return (f'mkdir -p {q(dst)} && mkdir -p {q(src)} && '
+                    f'cp -a {q(src)}/. {q(dst)}/')
         return (f'mkdir -p {q(dst)} && '
                 f'aws s3 sync {q(source)} {q(dst)}')
     raise exceptions.StorageError(f'COPY unsupported for {store}')
@@ -262,30 +418,50 @@ def copy_command(source: str, dst: str) -> str:
 
 def mount_command(source: str, mount_path: str,
                   cached: bool = False) -> str:
-    """FUSE mount command (parity: sky/data/mounting_utils.py; gcsfuse for
-    GCS, MOUNT_CACHED via gcsfuse file cache).  Under the fake-GCS
-    boundary a symlink into the fake root stands in for the FUSE mount —
-    same contract (writes land in the bucket), no FUSE needed."""
+    """FUSE mount command (parity: sky/data/mounting_utils.py:18-67;
+    gcsfuse for GCS with MOUNT_CACHED via its file cache, goofys for S3
+    with MOUNT_CACHED via rclone's VFS cache).  Under the fake roots a
+    symlink into the fake root stands in for the FUSE mount — same
+    contract (writes land in the bucket), no FUSE needed."""
     store = StoreType.from_url(source)
     q = shlex.quote
-    if store is not StoreType.GCS:
-        raise exceptions.StorageError(
-            f'MOUNT currently supports gs:// only, got {source}')
-    bucket_and_prefix = source[len('gs://'):]
-    root = _fake_root()
-    if root is not None:
-        target = os.path.join(root, bucket_and_prefix)
-        return (f'mkdir -p {q(target)} && '
-                f'mkdir -p "$(dirname {q(mount_path)})" && '
-                f'ln -sfn {q(target)} {q(mount_path)}')
-    bucket = bucket_and_prefix.split('/', 1)[0]
-    flags = '--implicit-dirs'
-    if cached:
-        flags += (' --file-cache-max-size-mb -1 '
-                  '--cache-dir ~/.skytpu/gcsfuse-cache')
-    return (f'mkdir -p {q(mount_path)} && '
-            f'(mountpoint -q {q(mount_path)} || '
-            f'gcsfuse {flags} {q(bucket)} {q(mount_path)})')
+    if store is StoreType.GCS:
+        bucket_and_prefix = source[len('gs://'):]
+        root = _fake_root()
+        if root is not None:
+            target = os.path.join(root, bucket_and_prefix)
+            return (f'mkdir -p {q(target)} && '
+                    f'mkdir -p "$(dirname {q(mount_path)})" && '
+                    f'ln -sfn {q(target)} {q(mount_path)}')
+        bucket = bucket_and_prefix.split('/', 1)[0]
+        flags = '--implicit-dirs'
+        if cached:
+            flags += (' --file-cache-max-size-mb -1 '
+                      '--cache-dir ~/.skytpu/gcsfuse-cache')
+        return (f'mkdir -p {q(mount_path)} && '
+                f'(mountpoint -q {q(mount_path)} || '
+                f'gcsfuse {flags} {q(bucket)} {q(mount_path)})')
+    if store is StoreType.S3:
+        bucket_and_prefix = source[len('s3://'):]
+        root = _fake_s3_root()
+        if root is not None:
+            target = os.path.join(root, bucket_and_prefix)
+            return (f'mkdir -p {q(target)} && '
+                    f'mkdir -p "$(dirname {q(mount_path)})" && '
+                    f'ln -sfn {q(target)} {q(mount_path)}')
+        bucket = bucket_and_prefix.split('/', 1)[0]
+        if cached:
+            # rclone VFS write-back cache (ref mounting_utils rclone
+            # path): survives re-reads without re-fetching.
+            return (f'mkdir -p {q(mount_path)} && '
+                    f'(mountpoint -q {q(mount_path)} || '
+                    f'rclone mount --daemon --vfs-cache-mode writes '
+                    f':s3:{q(bucket)} {q(mount_path)})')
+        return (f'mkdir -p {q(mount_path)} && '
+                f'(mountpoint -q {q(mount_path)} || '
+                f'goofys {q(bucket)} {q(mount_path)})')
+    raise exceptions.StorageError(
+        f'MOUNT supports gs:// and s3://, got {source}')
 
 
 def fetch_bucket_to_cluster(backend: 'tpu_vm_backend.TpuVmBackend',

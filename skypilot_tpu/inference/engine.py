@@ -345,10 +345,17 @@ class DecodeEngine:
         """
         if self._fmt_params is None:
             return
+        # Include the first power of two >= n_slots: _admit_group pads to
+        # the NEXT power of two, which exceeds n_slots when n_slots is not
+        # itself one (n_slots=6, burst of 5 -> pad 8) — without it the
+        # first such burst hits the mid-traffic compile stall prewarm
+        # exists to prevent.
         n = 1
         sizes = []
-        while n <= self.cfg.n_slots:
+        while True:
             sizes.append(n)
+            if n >= self.cfg.n_slots:
+                break
             n *= 2
         for bucket in self.cfg.prefill_buckets:
             for size in sizes:

@@ -406,6 +406,13 @@ def _max_parallel() -> int:
     return int(os.environ.get('SKYTPU_JOBS_MAX_PARALLEL', '16'))
 
 
+def live_controllers() -> list:
+    """Job ids with a live controller thread IN THIS PROCESS (dedicated
+    mode keeps this empty in the API server — the daemon owns them)."""
+    with _manager_lock:
+        return [jid for jid, th in _controllers.items() if th.is_alive()]
+
+
 def maybe_start_controllers() -> None:
     """Start controller threads for non-terminal jobs, newest-submitted
     last, up to the parallelism cap (parity:

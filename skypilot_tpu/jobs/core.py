@@ -1,9 +1,24 @@
 """Managed-jobs user API (parity: sky/jobs/server/core.py launch :244,
 queue, cancel; logs via the task cluster's agent).
+
+Two controller placements (parity: the reference's default launches
+controllers on their own clusters, sky/jobs/server/core.py:494,:527;
+consolidation mode keeps them in the API server):
+- consolidation (default): controller threads live in this process;
+- dedicated ("vm", config `jobs.controller.mode: vm`): a controller
+  cluster is launched through the normal stack and every verb ships to
+  it as a short agent job (jobs/remote_exec.py) against the
+  controller-local state DB; a persistent daemon there
+  (jobs/controller_daemon.py) keeps recovering jobs even when the API
+  server dies.
 """
 from __future__ import annotations
 
+import base64
+import io
+import json
 import os
+import shlex
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu import exceptions
@@ -13,6 +28,68 @@ from skypilot_tpu.jobs import controller as controller_lib
 from skypilot_tpu.jobs import state
 from skypilot_tpu.jobs.recovery_strategy import (StrategyName,
                                                  task_recovery_config)
+
+JOBS_CONTROLLER_CLUSTER = 'skytpu-jobs-controller'
+
+
+def _controller_mode() -> str:
+    # remote_exec sets the override ON the controller host so the verbs
+    # it runs operate locally instead of recursing remotely.
+    if os.environ.get('SKYTPU_JOBS_LOCAL_MODE') == '1':
+        return 'consolidation'
+    from skypilot_tpu import sky_config
+    return str(sky_config.get_nested(('jobs', 'controller', 'mode'),
+                                     'consolidation'))
+
+
+def _ensure_controller_cluster() -> None:
+    from skypilot_tpu import execution
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu import sky_config
+    from skypilot_tpu.global_user_state import ClusterStatus
+    record = global_user_state.get_cluster(JOBS_CONTROLLER_CLUSTER)
+    if record is not None and record['status'] is ClusterStatus.UP:
+        return
+    res_cfg = sky_config.get_nested(('jobs', 'controller', 'resources'),
+                                    {'cpus': '4+'})
+    t = task_lib.Task('jobs-controller', run=None)
+    t.set_resources(resources_lib.Resources.from_yaml_config(
+        dict(res_cfg)))
+    execution.launch(t, JOBS_CONTROLLER_CLUSTER, quiet_optimizer=True,
+                     policy_operation='jobs controller launch')
+
+
+def _remote_call(args: List[str]) -> Dict[str, Any]:
+    """Run one remote_exec verb on the controller cluster; parse the
+    sentinel JSON line back out of the job logs.
+
+    The acting user + workspace ride along as env so the verb executes
+    AS this caller on the controller host — its consolidation-path code
+    then runs the same RBAC/workspace guards it runs locally (without
+    this, any vm-mode caller could cancel anyone's job)."""
+    from skypilot_tpu import execution
+    from skypilot_tpu import users as users_lib
+    from skypilot_tpu import workspaces as workspaces_lib
+    from skypilot_tpu.backends import TpuVmBackend
+    from skypilot_tpu.jobs import remote_exec
+    cmd = ('PYTHONPATH="$HOME/skytpu_runtime:$PYTHONPATH" '
+           'SKYTPU_JOBS_LOCAL_MODE=1 '
+           f'SKYTPU_USER={shlex.quote(users_lib.current_user().name)} '
+           f'SKYTPU_WORKSPACE='
+           f'{shlex.quote(workspaces_lib.active_workspace())} '
+           f'python -m skypilot_tpu.jobs.remote_exec '
+           f'{shlex.join(args)}')
+    t = task_lib.Task('jobs-verb', run=cmd)
+    job_id, handle = execution.exec_(t, JOBS_CONTROLLER_CLUSTER)
+    backend = TpuVmBackend()
+    buf = io.StringIO()
+    rc = backend.tail_logs(handle, job_id, follow=True, out=buf)
+    for line in buf.getvalue().splitlines():
+        if line.startswith(remote_exec.SENTINEL):
+            return json.loads(line[len(remote_exec.SENTINEL):])
+    raise exceptions.ManagedJobStatusError(
+        f'controller verb {args[0]!r} produced no result '
+        f'(rc={rc}): {buf.getvalue()[-500:]}')
 
 
 def _recovery_config(task: task_lib.Task) -> Dict[str, Any]:
@@ -49,6 +126,13 @@ def launch(task_or_dag, name: Optional[str] = None) -> int:
         job_name = name or task_or_dag.name
     if not tasks:
         raise exceptions.InvalidDagError('managed job needs >= 1 task')
+    if _controller_mode() == 'vm':
+        _ensure_controller_cluster()
+        spec = {'name': job_name,
+                'tasks': [t.to_yaml_config() for t in tasks]}
+        payload = base64.b64encode(
+            json.dumps(spec).encode()).decode()
+        return int(_remote_call(['launch', payload])['job_id'])
     # Job-level defaults come from the first task; tasks with their own
     # job_recovery override per task in the controller.
     rec = _recovery_config(tasks[0])
@@ -61,13 +145,22 @@ def launch(task_or_dag, name: Optional[str] = None) -> int:
                           recovery_strategy=rec['strategy'],
                           max_restarts_on_errors=rec[
                               'max_restarts_on_errors'])
-    controller_lib.maybe_start_controllers()
+    # On a dedicated controller host the persistent daemon drives the
+    # job (remote_exec sets the skip: a controller thread started in the
+    # short-lived verb process would die mid-provision with it).
+    if os.environ.get('SKYTPU_JOBS_NO_CONTROLLERS') != '1':
+        controller_lib.maybe_start_controllers()
     return job_id
 
 
 def queue(refresh: bool = False,
           all_users: bool = False) -> List[Dict[str, Any]]:
     del refresh  # controller threads keep state fresh
+    if _controller_mode() == 'vm' and \
+            global_user_state.get_cluster(
+                JOBS_CONTROLLER_CLUSTER) is not None:
+        return _remote_call(['queue',
+                             '1' if all_users else '0'])['jobs']
     from skypilot_tpu import users as users_lib
     from skypilot_tpu import workspaces as workspaces_lib
     records = [r for r in state.list_jobs()
@@ -82,6 +175,10 @@ def queue(refresh: bool = False,
 def cancel(job_id: int) -> bool:
     """Request cancellation; the controller cancels the cluster job and
     tears the cluster down."""
+    if _controller_mode() == 'vm' and \
+            global_user_state.get_cluster(
+                JOBS_CONTROLLER_CLUSTER) is not None:
+        return bool(_remote_call(['cancel', str(job_id)])['cancelled'])
     from skypilot_tpu import users as users_lib
     from skypilot_tpu import workspaces as workspaces_lib
     rec = state.get(job_id)
@@ -92,7 +189,7 @@ def cancel(job_id: int) -> bool:
             {'name': f'managed job {job_id}',
              'user_name': rec['user_name']}, 'jobs cancel')
     ok = state.request_cancel(job_id)
-    if ok:
+    if ok and os.environ.get('SKYTPU_JOBS_NO_CONTROLLERS') != '1':
         # Adopt orphaned jobs (e.g. after an API-server restart) so the
         # cancel is actually processed.
         controller_lib.maybe_start_controllers()
@@ -123,6 +220,29 @@ def snapshot_to_serve(rec: Dict[str, Any]) -> Optional[str]:
 
 
 def tail_logs(job_id: int, follow: bool = True, out=None) -> int:
+    if _controller_mode() == 'vm' and \
+            global_user_state.get_cluster(
+                JOBS_CONTROLLER_CLUSTER) is not None:
+        import sys
+        import time as time_lib
+        stream = out or sys.stdout
+        emitted = 0
+        while True:
+            result = _remote_call(['logs', str(job_id)])
+            if 'error' in result:
+                raise exceptions.JobNotFoundError(f'managed job {job_id}')
+            text = result.get('logs', '')
+            if len(text) > emitted:
+                stream.write(text[emitted:])
+                stream.flush()
+                emitted = len(text)
+            status = state.ManagedJobStatus(result['status'])
+            if status.is_terminal():
+                return 0 if status is \
+                    state.ManagedJobStatus.SUCCEEDED else 1
+            if not follow:
+                return 0
+            time_lib.sleep(2.0)
     rec = state.get(job_id)
     if rec is None:
         raise exceptions.JobNotFoundError(f'managed job {job_id}')

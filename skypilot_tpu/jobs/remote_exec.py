@@ -1,0 +1,109 @@
+"""Managed-jobs verbs executed ON the dedicated controller cluster.
+
+The client ships each verb as a short agent job on the controller
+cluster (jobs/core.py dedicated mode); this module runs there, against
+the CONTROLLER-LOCAL state DB, and prints one sentinel-prefixed JSON
+line the client parses back out of the job logs — the same ship-codegen,
+run-on-head, parse-stdout loop the reference uses for its jobs
+controller (sky/jobs/server/core.py + codegen).
+
+Every verb also ensures the persistent controller daemon
+(controller_daemon.py) is running, detached, so controllers survive both
+this short-lived process and any API-server restarts.
+
+Usage (on the controller host):
+  python -m skypilot_tpu.jobs.remote_exec launch <base64(json)>
+  python -m skypilot_tpu.jobs.remote_exec queue
+  python -m skypilot_tpu.jobs.remote_exec cancel <job_id>
+  python -m skypilot_tpu.jobs.remote_exec logs <job_id>
+"""
+from __future__ import annotations
+
+import base64
+import json
+import os
+import subprocess
+import sys
+
+SENTINEL = 'SKYTPU_REMOTE_RESULT:'
+
+
+def ensure_daemon() -> None:
+    from skypilot_tpu.jobs import controller_daemon
+    if controller_daemon.daemon_alive():
+        return
+    env = dict(os.environ)
+    import skypilot_tpu
+    pkg_parent = os.path.dirname(os.path.dirname(
+        os.path.abspath(skypilot_tpu.__file__)))
+    env['PYTHONPATH'] = (pkg_parent + os.pathsep +
+                         env.get('PYTHONPATH', '')).rstrip(os.pathsep)
+    subprocess.Popen(
+        [sys.executable, '-m', 'skypilot_tpu.jobs.controller_daemon'],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        start_new_session=True)
+
+
+def _emit(payload) -> None:
+    print(f'{SENTINEL}{json.dumps(payload, default=str)}', flush=True)
+
+
+def main(argv) -> int:
+    # The verbs below must act on THIS host's state DB, never recurse
+    # through dedicated-mode routing; the persistent daemon (not this
+    # short-lived process) drives the controllers.
+    os.environ['SKYTPU_JOBS_LOCAL_MODE'] = '1'
+    os.environ['SKYTPU_JOBS_NO_CONTROLLERS'] = '1'
+    verb = argv[0]
+    from skypilot_tpu import dag as dag_lib
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu.jobs import core as jobs_core
+    from skypilot_tpu.jobs import state
+    ensure_daemon()
+    if verb == 'launch':
+        spec = json.loads(base64.b64decode(argv[1]))
+        tasks = [task_lib.Task.from_yaml_config(c)
+                 for c in spec['tasks']]
+        if len(tasks) == 1:
+            job_id = jobs_core.launch(tasks[0], name=spec.get('name'))
+        else:
+            dag = dag_lib.Dag(name=spec.get('name'))
+            prev = None
+            for t in tasks:
+                dag.add(t)
+                if prev is not None:
+                    dag.add_edge(prev, t)
+                prev = t
+            job_id = jobs_core.launch(dag, name=spec.get('name'))
+        _emit({'job_id': job_id})
+    elif verb == 'queue':
+        all_users = len(argv) > 1 and argv[1] == '1'
+        records = []
+        for rec in jobs_core.queue(all_users=all_users):
+            rec = dict(rec)
+            status = rec.get('status')
+            if hasattr(status, 'value'):
+                rec['status'] = status.value
+            records.append(rec)
+        _emit({'jobs': records})
+    elif verb == 'cancel':
+        _emit({'cancelled': jobs_core.cancel(int(argv[1]))})
+    elif verb == 'logs':
+        rec = state.get(int(argv[1]))
+        if rec is None:
+            _emit({'error': 'not found'})
+            return 1
+        path = state.log_path(rec['job_id'])
+        text = ''
+        if os.path.exists(path):
+            with open(path, 'r', errors='replace') as f:
+                text = f.read()
+        _emit({'logs': text, 'status': rec['status'].value})
+    else:
+        _emit({'error': f'unknown verb {verb}'})
+        return 2
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main(sys.argv[1:]))

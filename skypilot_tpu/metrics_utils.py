@@ -27,6 +27,10 @@ logger = sky_logging.init_logger(__name__)
 
 _CLUSTER_LABEL = 'skytpu-cluster'
 
+# (cluster, pod) label pairs written by the previous scrape, so vanished
+# pods' gauge series can be removed instead of going stale.
+_last_scraped_pods: set = set()
+
 
 def _parse_cpu(q: str) -> float:
     """k8s cpu quantity -> millicores ('250m' -> 250, '2' -> 2000)."""
@@ -102,11 +106,13 @@ def scrape_once(context: Optional[str] = None) -> List[Dict]:
     except Exception as e:  # pylint: disable=broad-except
         logger.debug(f'metrics-server scrape failed: {e}')
 
+    written = set()
     for name, cluster in cluster_by_pod.items():
         row = {'pod': name, 'cluster': cluster,
                'tpu_chips': chips_by_pod.get(name, 0)}
         row.update(usage_by_pod.get(name, {}))
         rows.append(row)
+        written.add((cluster, name))
         metrics_lib.set_gauge('skytpu_k8s_pod_tpu_chips',
                               row['tpu_chips'], cluster=cluster,
                               pod=name)
@@ -117,6 +123,15 @@ def scrape_once(context: Optional[str] = None) -> List[Dict]:
             metrics_lib.set_gauge('skytpu_k8s_pod_memory_bytes',
                                   row['memory_bytes'], cluster=cluster,
                                   pod=name)
+    # Drop series for pods that disappeared since the previous scrape —
+    # /metrics would otherwise keep reporting torn-down clusters forever.
+    global _last_scraped_pods
+    for cluster, name in _last_scraped_pods - written:
+        for metric in ('skytpu_k8s_pod_tpu_chips',
+                       'skytpu_k8s_pod_cpu_millicores',
+                       'skytpu_k8s_pod_memory_bytes'):
+            metrics_lib.remove_gauge(metric, cluster=cluster, pod=name)
+    _last_scraped_pods = written
     return rows
 
 

@@ -80,8 +80,18 @@ def flash_attention(q: jax.Array,
     return _flash_fwd_impl(q, k, v, causal, block_size)
 
 
+def _backend() -> str:
+    """jax.default_backend(), or 'cpu' when no backend can initialize
+    (abstract-only analysis, e.g. placement validation's eval_shape
+    tracing on a machine with no usable runtime)."""
+    try:
+        return jax.default_backend()
+    except RuntimeError:
+        return 'cpu'
+
+
 def _flash_fwd_impl(q, k, v, causal, block_size):
-    if jax.default_backend() == 'tpu':
+    if _backend() == 'tpu':
         from skypilot_tpu.ops.pallas import flash_attention as pallas_fa
         return pallas_fa.flash_attention_fwd(q, k, v, causal=causal,
                                              block_size=block_size)
@@ -89,7 +99,7 @@ def _flash_fwd_impl(q, k, v, causal, block_size):
 
 
 def _flash_fwd(q, k, v, causal, block_size):
-    if jax.default_backend() == 'tpu':
+    if _backend() == 'tpu':
         from skypilot_tpu.ops.pallas import flash_attention as pallas_fa
         out, lse = pallas_fa.flash_attention_fwd(
             q, k, v, causal=causal, block_size=block_size,

@@ -51,7 +51,7 @@ def bootstrap_command(image: str,
         f'CUR=$(docker inspect '
         f'-f "{{{{.Config.Image}}}} {{{{.State.Running}}}}" {name} '
         f'2>/dev/null || true); '
-        f'if [ "$CUR" != "{image} true" ]; then '
+        f'if [ "$CUR" != {shlex.quote(f"{image} true")} ]; then '
         f'  docker rm -f {name} >/dev/null 2>&1 || true; '
         f'  docker pull {img} && '
         f'  docker run -d --privileged --network=host --name {name} '

@@ -334,12 +334,32 @@ class ReplicaManager:
             failures = self._probe_failures.get(rid, 0) + 1
             self._probe_failures[rid] = failures
             if status is ReplicaStatus.STARTING:
-                if now - rec['launched_at'] > \
-                        self.spec.readiness_probe.initial_delay_seconds:
+                # Grace is judged in PROBE ATTEMPTS as well as wall
+                # clock: the replica must have actually been probed as
+                # often as an unstarved clock would have allowed.  Under
+                # host CPU starvation (heavily loaded CI box) controller
+                # ticks stretch, attempts accumulate slowly and the
+                # window stretches with the machine — a wall-clock-only
+                # deadline replaces perfectly healthy-but-slow replicas,
+                # and each replacement adds churn that makes the
+                # starvation worse.
+                from skypilot_tpu.serve import controller as controller_m
+                delay = self.spec.readiness_probe.initial_delay_seconds
+                # Worst-case cost of one failed attempt is a full probe
+                # TIMEOUT plus the tick; dividing by the tick alone would
+                # demand more attempts than an unstarved host can make
+                # within the delay (black-holed endpoints would then sit
+                # unreplaced for timeout/tick times longer than asked).
+                per_attempt = (controller_m._tick_interval() +  # pylint: disable=protected-access
+                               self.spec.readiness_probe.timeout_seconds)
+                expected_attempts = max(
+                    3, int(delay / max(per_attempt, 0.05)))
+                if (now - rec['launched_at'] > delay and
+                        failures >= expected_attempts):
                     logger.warning(
                         f'Service {self.service_name!r}: replica {rid} '
-                        f'never became ready within initial delay; '
-                        f'replacing.')
+                        f'never became ready within initial delay '
+                        f'({failures} failed probes); replacing.')
                     self.terminate_replica(rid)
                     serve_state.set_replica_status(
                         self.service_name, rid, ReplicaStatus.FAILED)

@@ -728,6 +728,23 @@ def make_app() -> web.Application:
                 out[name] = {'enabled': ok, 'reason': reason,
                              'storage': {'enabled': s_ok,
                                          'reason': s_reason}}
+            # Config-level warnings ride along under a reserved key
+            # (currently: RBAC enabled but identity spoofable by any
+            # shared-token holder — also warned at server startup).
+            from skypilot_tpu.utils import auth
+            # Config-level warnings are OPT-IN (?warnings=1): released
+            # clients iterate /check's entries as clouds — the same
+            # compat contract that keeps catalog staleness on its own
+            # route — so a surprise non-cloud key would crash them.
+            if request.query.get('warnings') == '1':
+                warnings = []
+                if auth.warn_if_spoofable_rbac(logger):
+                    warnings.append(
+                        'users: RBAC is enabled but only a shared '
+                        'api_server.auth_token gates the API — any '
+                        'token holder can act as any user; configure '
+                        'per-user api_server.tokens.')
+                out['_warnings'] = warnings
             return out
 
         out = await asyncio.get_event_loop().run_in_executor(None,

@@ -321,11 +321,13 @@ class RequestExecutor:
     def submit(self, name: str, body: Dict[str, Any],
                fn: Callable[[], Any], long: bool = True) -> str:
         import os
+        # Born-claimed (single INSERT): a sibling worker's recovery must
+        # never observe this thread-work row unclaimed — it cannot see
+        # our thread and would mark it FAILED ('server restarted') while
+        # we still execute it.
         request_id = requests_db.create(name, body,
-                                        'long' if long else 'short')
-        # Claim thread work too: a sibling worker's recovery must know a
-        # live process owns this row (it cannot see our thread).
-        requests_db.try_claim(request_id, os.getpid())
+                                        'long' if long else 'short',
+                                        claim_pid=os.getpid())
         pool = self._long if long else self._short
 
         def work():

@@ -44,6 +44,13 @@ def set_gauge(metric: str, value: float, **labels: str) -> None:
         _gauges[_key(metric, labels)] = value
 
 
+def remove_gauge(metric: str, **labels: str) -> None:
+    """Drop one labeled series (e.g. a torn-down pod's gauges — leaving
+    them would report stale values forever)."""
+    with _lock:
+        _gauges.pop(_key(metric, labels), None)
+
+
 def add_gauge(metric: str, delta: float, **labels: str) -> None:
     with _lock:
         k = _key(metric, labels)

@@ -77,14 +77,23 @@ def _ensure() -> str:
 
 
 def create(name: str, body: Dict[str, Any],
-           schedule_type: str = 'long') -> str:
+           schedule_type: str = 'long',
+           claim_pid: Optional[int] = None) -> str:
+    """Insert a PENDING row; with claim_pid the row is born CLAIMED in the
+    same INSERT.  Thread-pool work (executor.submit) must claim
+    atomically: a row visible unclaimed for even a moment can be seen by
+    a concurrently-booting sibling worker's recover() — which cannot run
+    a thread closure — and marked FAILED while this worker executes it."""
     request_id = uuid.uuid4().hex[:16]
+    now = time.time()
     db_utils.execute(
         _ensure(),
         'INSERT INTO requests (request_id, name, status, created_at, body, '
-        'schedule_type, user) VALUES (?,?,?,?,?,?,?)',
-        (request_id, name, RequestStatus.PENDING.value, time.time(),
-         json.dumps(body), schedule_type, body.get('_user')))
+        'schedule_type, user, claim_pid, claim_at) '
+        'VALUES (?,?,?,?,?,?,?,?,?)',
+        (request_id, name, RequestStatus.PENDING.value, now,
+         json.dumps(body), schedule_type, body.get('_user'), claim_pid,
+         now if claim_pid is not None else None))
     return request_id
 
 

@@ -35,37 +35,31 @@ import pytest  # noqa: E402
 #   load  — throughput / soak
 # Non-unit modules additionally get an xdist_group: under `-n N --dist
 # loadgroup` every test of one group runs on ONE worker.  This machine has
-# a SINGLE CPU core (nproc=1) — xdist only time-slices — so the groups are
-# chosen to cap how many CPU-hog tests can run concurrently: all
-# model-tier modules share just two groups (compile tests starve the
-# wall-clock deadlines of e2e scenarios otherwise), while each e2e/chaos/
-# load module serializes internally but may overlap with others (their
-# tests are mostly sleep/IO-bound).  Round-4's -n4 flakes were exactly
-# this starvation: JAX compile tests time-slicing against serve replicas'
-# readiness deadlines.
+# a SINGLE CPU core (nproc=1) — xdist only time-slices — so heavy tests
+# run in exactly TWO serial lanes: one for JAX compile tests (pure CPU
+# hogs with no wall-clock deadlines) and one for the timing-sensitive
+# e2e/chaos/load scenarios (sleep-bound with CPU bursts and real
+# deadlines).  At most one of each runs at any moment, so the e2e lane
+# always gets ~half the core — measured round-5: four streams (2 jax + 2
+# e2e) starved serve tests to 4x their intrinsic time and past their
+# deadlines, two lanes do not.  Light unit tests fill the remaining
+# workers.  Round-4's -n4 flakes were exactly this starvation.
 # ---------------------------------------------------------------------------
 _CHAOS_MODULES = {'test_chaos'}
 _LOAD_MODULES = {'test_load'}
 _MODEL_MODULES = {
     'test_models_train', 'test_models_zoo', 'test_moe_pipeline',
     'test_ops', 'test_inference', 'test_multislice',
+    'test_placement_validate',
 }
 _E2E_MODULES = {
     'test_agent_events', 'test_api_server', 'test_autostop',
-    'test_client_server_compat', 'test_dashboard_misc',
-    'test_docker_runtime', 'test_execution_e2e', 'test_fuse_proxy',
-    'test_managed_jobs', 'test_multiworker', 'test_serve',
-    'test_server_daemons', 'test_ssh_gang', 'test_transfer_logs',
+    'test_client_server_compat', 'test_controller_vm',
+    'test_dashboard_misc', 'test_docker_runtime', 'test_execution_e2e',
+    'test_fuse_proxy', 'test_managed_jobs', 'test_multiworker',
+    'test_serve', 'test_server_daemons', 'test_ssh_gang',
+    'test_transfer_logs',
 }
-# Cap concurrent CPU-bound JAX tests at 2 of the N workers.
-_MODEL_GROUP_OF = {
-    'test_models_train': 'jax-a', 'test_ops': 'jax-a',
-    'test_multislice': 'jax-a',
-    'test_models_zoo': 'jax-b', 'test_moe_pipeline': 'jax-b',
-    'test_inference': 'jax-b',
-}
-
-
 def pytest_configure(config):
     """Honor the xdist_group markers automatically: when xdist is active
     with its default scheduler, switch to loadgroup.  Done here (not in
@@ -91,9 +85,10 @@ def pytest_collection_modifyitems(config, items):
         else:
             tier = 'unit'
         item.add_marker(getattr(pytest.mark, tier))
-        if tier != 'unit':
-            item.add_marker(pytest.mark.xdist_group(
-                _MODEL_GROUP_OF.get(stem, stem)))
+        if tier == 'model':
+            item.add_marker(pytest.mark.xdist_group('lane-jax'))
+        elif tier != 'unit':
+            item.add_marker(pytest.mark.xdist_group('lane-e2e'))
 
 
 @pytest.fixture(autouse=True)

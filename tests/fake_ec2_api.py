@@ -1,0 +1,143 @@
+"""In-process fake EC2 control plane for the AWS provisioner tests
+(sibling of fake_gce_api.py / fake_tpu_api.py; the real transport is
+boto3 — the fake speaks the thin JSON protocol of
+provision/aws/ec2_client.py's fake path).  Scriptable per-region
+behavior:
+  fake.set_region_behavior('us-east-1', 'stockout' | 'quota' | 'ok')
+plus spot interruption (`interrupt`) for recovery tests.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict
+
+
+class _State:
+    def __init__(self):
+        self.instances: Dict[str, dict] = {}     # key: region/name
+        self.region_behavior: Dict[str, str] = {}
+        self.lock = threading.Lock()
+        self._ip_count = 0
+
+
+class FakeEc2Api:
+    def __init__(self):
+        self.state = _State()
+        handler = self._make_handler()
+        self.server = ThreadingHTTPServer(('127.0.0.1', 0), handler)
+        self.thread = threading.Thread(target=self.server.serve_forever,
+                                       daemon=True)
+        self.thread.start()
+
+    @property
+    def endpoint(self) -> str:
+        return f'http://127.0.0.1:{self.server.server_port}'
+
+    def close(self):
+        self.server.shutdown()
+
+    # ----- scripting ---------------------------------------------------------
+    def set_region_behavior(self, region: str, behavior: str):
+        self.state.region_behavior[region] = behavior
+
+    def instance(self, region: str, name: str) -> dict:
+        return self.state.instances[f'{region}/{name}']
+
+    def interrupt(self, region: str, name: str):
+        """Spot interruption: the instance goes terminated."""
+        with self.state.lock:
+            self.state.instances[f'{region}/{name}']['state'] = 'terminated'
+
+    # ----- handler -----------------------------------------------------------
+    def _make_handler(self):
+        state = self.state
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, code: int, body: dict):
+                blob = json.dumps(body).encode()
+                self.send_response(code)
+                self.send_header('Content-Type', 'application/json')
+                self.send_header('Content-Length', str(len(blob)))
+                self.end_headers()
+                self.wfile.write(blob)
+
+            def _error(self, code: int, aws_code: str, message: str):
+                self._send(code, {'error': {'code': aws_code,
+                                            'message': message}})
+
+            def _body(self) -> dict:
+                length = int(self.headers.get('Content-Length', 0))
+                return json.loads(self.rfile.read(length) or b'{}')
+
+            def do_GET(self):
+                path, _, query = self.path.partition('?')
+                params = dict(p.split('=', 1) for p in query.split('&')
+                              if '=' in p)
+                if path == '/instances':
+                    region = params.get('region', '')
+                    cluster = params.get('cluster', '')
+                    with state.lock:
+                        out = [dict(i) for k, i in state.instances.items()
+                               if k.startswith(f'{region}/') and
+                               i['cluster'] == cluster and
+                               i['state'] != 'terminated']
+                    return self._send(200, {'instances': out})
+                return self._error(404, 'InvalidAction', path)
+
+            def do_POST(self):
+                body = self._body()
+                region = body.get('region', '')
+                if self.path == '/run_instances':
+                    behavior = state.region_behavior.get(region, 'ok')
+                    if behavior == 'stockout':
+                        return self._error(
+                            400, 'InsufficientInstanceCapacity',
+                            'There is no Spot capacity available that '
+                            'matches your request.')
+                    if behavior == 'quota':
+                        return self._error(
+                            400, 'VcpuLimitExceeded',
+                            'You have requested more vCPU capacity than '
+                            'your current vCPU limit.')
+                    with state.lock:
+                        state._ip_count += 1
+                        inst = {
+                            'instance_id': f'i-{uuid.uuid4().hex[:12]}',
+                            'name': body['name'],
+                            'cluster': body['cluster'],
+                            'instance_type': body['instance_type'],
+                            'state': 'running',
+                            'use_spot': bool(body.get('use_spot')),
+                            'public_ip': f'54.0.0.{state._ip_count}',
+                            'private_ip': f'10.1.0.{state._ip_count}',
+                            'zone': body.get('zone') or f'{region}a',
+                        }
+                        state.instances[f'{region}/{body["name"]}'] = inst
+                    return self._send(200, {'instance': inst})
+                if self.path in ('/terminate', '/stop', '/start'):
+                    cluster = body.get('cluster', '')
+                    names = body.get('names')
+                    new_state = {'/terminate': 'terminated',
+                                 '/stop': 'stopped',
+                                 '/start': 'running'}[self.path]
+                    with state.lock:
+                        for key, inst in state.instances.items():
+                            if not key.startswith(f'{region}/'):
+                                continue
+                            if inst['cluster'] != cluster:
+                                continue
+                            if names is not None and \
+                                    inst['name'] not in names:
+                                continue
+                            if inst['state'] != 'terminated':
+                                inst['state'] = new_state
+                    return self._send(200, {})
+                return self._error(404, 'InvalidAction', self.path)
+
+        return Handler

@@ -1,0 +1,252 @@
+"""AWS substrate: EC2 provisioning (fake API), S3 storage (fake root),
+catalog, optimizer routing, and the failover engine across regions.
+
+Mirrors the GCP coverage split: provisioning lifecycle against
+tests/fake_ec2_api.py (sibling of fake_gce_api.py), storage against
+SKYTPU_FAKE_S3_ROOT (sibling of the fake-GCS boundary), feasibility and
+pricing from catalog/data/aws_vms.csv.  Ref: sky/clouds/aws.py,
+sky/provision/aws/instance.py, sky/data/storage.py:4502 (S3Store).
+"""
+import os
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import provision
+from skypilot_tpu.catalog import aws_catalog
+from skypilot_tpu.clouds import get_cloud
+from skypilot_tpu.data import storage as storage_lib
+from skypilot_tpu.provision import failover
+from skypilot_tpu.provision.common import InstanceStatus, ProvisionConfig
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.task import Task
+
+
+@pytest.fixture
+def fake_ec2(monkeypatch):
+    from tests.fake_ec2_api import FakeEc2Api
+    fake = FakeEc2Api()
+    monkeypatch.setenv('SKYTPU_EC2_API_ENDPOINT', fake.endpoint)
+    yield fake
+    fake.close()
+
+
+@pytest.fixture
+def fake_s3(tmp_path, monkeypatch):
+    root = tmp_path / 's3root'
+    root.mkdir()
+    monkeypatch.setenv('SKYTPU_FAKE_S3_ROOT', str(root))
+    return root
+
+
+def _config(cluster='awsc', region='us-east-1', instance_type='m6i.large',
+            num_nodes=1, spot=False):
+    return ProvisionConfig(
+        cluster_name=cluster, num_nodes=num_nodes,
+        resources_config={'instance_type': instance_type,
+                          'use_spot': spot,
+                          'infra': f'aws/{region}'},
+        region=region)
+
+
+# ----- catalog ---------------------------------------------------------------
+def test_catalog_spec_and_pricing():
+    vcpus, mem = aws_catalog.get_vm_spec('m6i.xlarge')
+    assert vcpus == 4 and mem == 16
+    east = aws_catalog.get_vm_hourly_cost('m6i.xlarge', 'us-east-1')
+    eu = aws_catalog.get_vm_hourly_cost('m6i.xlarge', 'eu-west-1')
+    assert eu > east                       # per-region prices differ
+    spot = aws_catalog.get_vm_hourly_cost('m6i.xlarge', 'us-east-1',
+                                          use_spot=True)
+    assert spot < east
+
+
+def test_catalog_default_instance_type():
+    assert aws_catalog.get_default_instance_type('4', '16') == 'm6i.xlarge'
+    assert aws_catalog.get_default_instance_type('64+') is None
+
+
+# ----- cloud feasibility -----------------------------------------------------
+def test_feasible_resources_fans_out_regions():
+    res = Resources.from_yaml_config({'infra': 'aws', 'cpus': '4'})
+    cands = get_cloud('aws').get_feasible_resources(res)
+    assert {c.region for c in cands} == set(aws_catalog.regions())
+    assert all(c.instance_type for c in cands)
+
+
+def test_tpu_requests_not_feasible_on_aws():
+    res = Resources.from_yaml_config({'accelerators': 'tpu-v5e-8'})
+    assert get_cloud('aws').get_feasible_resources(res) == []
+
+
+def test_optimizer_routes_cpu_task_to_cheapest(monkeypatch):
+    monkeypatch.setenv('SKYTPU_ENABLED_CLOUDS', 'aws')
+    from skypilot_tpu.optimizer import fill_in_launchable_resources
+    t = Task('cpu', run='echo hi')
+    t.set_resources(Resources.from_yaml_config({'infra': 'aws',
+                                                'cpus': '2',
+                                                'memory': '8'}))
+    per_request = fill_in_launchable_resources(t, None)
+    cands = next(iter(per_request.values()))
+    assert cands and cands[0].cloud == 'aws'
+    # cheapest first: us-east-1/us-west-2 m6i.large ($0.096) beats
+    # eu-west-1 ($0.107)
+    assert cands[0].region in ('us-east-1', 'us-west-2')
+
+
+# ----- provisioning lifecycle ------------------------------------------------
+def test_ec2_lifecycle(fake_ec2, tmp_home):
+    record = provision.run_instances('aws', _config(num_nodes=2))
+    assert record.instance_ids == ['awsc-0', 'awsc-1']
+    provision.wait_instances('aws', 'awsc', region='us-east-1',
+                             timeout_s=30)
+    statuses = provision.query_instances('aws', 'awsc', region='us-east-1')
+    assert statuses == {'awsc-0': InstanceStatus.RUNNING,
+                        'awsc-1': InstanceStatus.RUNNING}
+    info = provision.get_cluster_info('aws', 'awsc', region='us-east-1')
+    assert len(info.instances) == 2 and info.head_ip
+    assert fake_ec2.instance('us-east-1', 'awsc-0')[
+        'instance_type'] == 'm6i.large'
+
+    provision.stop_instances('aws', 'awsc', region='us-east-1')
+    statuses = provision.query_instances('aws', 'awsc', region='us-east-1')
+    assert all(s is InstanceStatus.STOPPED for s in statuses.values())
+
+    # run_instances on a stopped cluster restarts in place (resume).
+    record = provision.run_instances('aws', _config(num_nodes=2))
+    assert record.resumed
+    provision.wait_instances('aws', 'awsc', region='us-east-1',
+                             timeout_s=30)
+
+    provision.terminate_instances('aws', 'awsc', region='us-east-1')
+    assert provision.query_instances('aws', 'awsc',
+                                     region='us-east-1') == {}
+
+
+def test_ec2_spot_interruption_visible(fake_ec2, tmp_home):
+    provision.run_instances('aws', _config(cluster='spotc', spot=True))
+    provision.wait_instances('aws', 'spotc', region='us-east-1',
+                             timeout_s=30)
+    fake_ec2.interrupt('us-east-1', 'spotc-0')
+    # A terminated spot instance disappears from the listing — the
+    # reconciler reads that as the cluster being gone and re-provisions.
+    assert provision.query_instances('aws', 'spotc',
+                                     region='us-east-1') == {}
+
+
+def test_ec2_stockout_classified(fake_ec2, tmp_home):
+    fake_ec2.set_region_behavior('us-east-1', 'stockout')
+    with pytest.raises(exceptions.InsufficientCapacityError):
+        provision.run_instances('aws', _config())
+
+
+def test_ec2_quota_classified(fake_ec2, tmp_home):
+    fake_ec2.set_region_behavior('us-east-1', 'quota')
+    with pytest.raises(exceptions.QuotaExceededError):
+        provision.run_instances('aws', _config())
+
+
+# ----- failover e2e over the fake control plane ------------------------------
+def test_cpu_task_fails_over_regions_on_fake_ec2(fake_ec2, tmp_home,
+                                                 monkeypatch):
+    """End-to-end launch path up to RUNNING instances: optimizer
+    candidates -> failover engine -> fake-EC2 creates, with us-east-1
+    stocked out so the launch lands in the next-cheapest region."""
+    monkeypatch.setenv('SKYTPU_ENABLED_CLOUDS', 'aws')
+    fake_ec2.set_region_behavior('us-east-1', 'stockout')
+    task = Task('cpu', run='echo hi')
+    task.set_resources(Resources.from_yaml_config(
+        {'infra': 'aws', 'cpus': '2', 'memory': '8'}))
+
+    def provision_fn(candidate):
+        config = ProvisionConfig(
+            cluster_name='fo', num_nodes=task.num_nodes,
+            resources_config=candidate.to_yaml_config(),
+            region=candidate.region, zone=candidate.zone)
+        record = provision.run_instances(candidate.cloud, config)
+        provision.wait_instances(candidate.cloud, 'fo',
+                                 region=record.region, timeout_s=30)
+        return record
+
+    def cleanup_fn(candidate):
+        provision.terminate_instances(candidate.cloud, 'fo',
+                                      region=candidate.region)
+
+    result = failover.provision_with_retries(task, 'fo', provision_fn,
+                                             cleanup_fn=cleanup_fn)
+    assert result.record.region == 'us-west-2'    # same price as east
+    statuses = provision.query_instances('aws', 'fo', region='us-west-2')
+    assert statuses == {'fo-0': InstanceStatus.RUNNING}
+    assert provision.query_instances('aws', 'fo',
+                                     region='us-east-1') == {}
+
+
+# ----- S3 storage ------------------------------------------------------------
+def test_s3_store_lifecycle_and_sync(fake_s3, tmp_path):
+    store = storage_lib.S3Store('mybkt')
+    assert not store.exists()
+    store.create()
+    assert store.exists()
+    src = tmp_path / 'src'
+    (src / 'sub').mkdir(parents=True)
+    (src / 'a.txt').write_text('A')
+    (src / 'sub' / 'b.txt').write_text('B')
+    (src / 'skip.pyc').write_text('x')
+    (src / '.skyignore').write_text('*.pyc\n')
+    store.sync_up(str(src))
+    assert store.list_prefix() == ['a.txt', 'sub/b.txt']
+    down = tmp_path / 'down'
+    store.sync_down(str(down))
+    assert (down / 'sub' / 'b.txt').read_text() == 'B'
+    store.delete()
+    assert not store.exists()
+
+
+def test_store_for_url_routing(fake_s3, tmp_path, monkeypatch):
+    monkeypatch.setenv('SKYTPU_FAKE_GCS_ROOT', str(tmp_path / 'gcs'))
+    assert isinstance(storage_lib.store_for_url('s3://b'),
+                      storage_lib.S3Store)
+    assert isinstance(storage_lib.store_for_url('gs://b'),
+                      storage_lib.GcsStore)
+
+
+def test_s3_copy_and_mount_commands(fake_s3):
+    cmd = storage_lib.copy_command('s3://bkt/ckpt', '/dst')
+    assert 'cp -a' in cmd and 'bkt/ckpt' in cmd     # fake-root variant
+    mnt = storage_lib.mount_command('s3://bkt', '/mnt/data')
+    assert 'ln -sfn' in mnt                          # fake-root variant
+
+
+def test_s3_real_commands_without_fake_root(monkeypatch):
+    monkeypatch.delenv('SKYTPU_FAKE_S3_ROOT', raising=False)
+    cmd = storage_lib.copy_command('s3://bkt/ckpt', '/dst')
+    assert 'aws s3 sync' in cmd
+    mnt = storage_lib.mount_command('s3://bkt', '/mnt/data')
+    assert 'goofys' in mnt
+    cached = storage_lib.mount_command('s3://bkt', '/mnt/data',
+                                       cached=True)
+    assert 'rclone mount' in cached
+
+
+def test_named_s3_storage_mount_materializes(fake_s3, tmp_path):
+    src = tmp_path / 'data'
+    src.mkdir()
+    (src / 'w.bin').write_text('weights')
+    mount = storage_lib.StorageMount.from_yaml_config(
+        '/mnt/w', {'name': 'wbkt', 'source': str(src), 'store': 's3'})
+    url = mount.materialize()
+    assert url == 's3://wbkt'
+    assert (fake_s3 / 'wbkt' / 'w.bin').read_text() == 'weights'
+
+
+def test_aws_credential_check_modes(monkeypatch):
+    cloud = get_cloud('aws')
+    for var in ('SKYTPU_EC2_API_ENDPOINT', 'AWS_ACCESS_KEY_ID',
+                'AWS_SECRET_ACCESS_KEY'):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv('AWS_SHARED_CREDENTIALS_FILE', '/nonexistent')
+    ok, reason = cloud.check_credentials()
+    assert not ok and 'credentials' in reason.lower()
+    monkeypatch.setenv('AWS_ACCESS_KEY_ID', 'AKIATEST')
+    monkeypatch.setenv('AWS_SECRET_ACCESS_KEY', 'secret')
+    assert cloud.check_credentials() == (True, None)

@@ -1,0 +1,96 @@
+"""Catalog data fetcher, end to end from recorded billing-SKU fixtures.
+
+The fixture (tests/fixtures/gcp_billing_skus.json) mirrors the Cloud
+Billing API's response pages exactly (vcr-style recording), so the whole
+fetch -> parse -> derive -> write-CSV -> catalog-reads-refreshed-file
+path runs hermetically.  Ref: sky/catalog/data_fetchers/fetch_gcp.py +
+the hosted-CSV refresh in sky/catalog/common.py:211.
+"""
+import json
+import os
+
+import pytest
+
+from skypilot_tpu.catalog import common
+from skypilot_tpu.catalog.data_fetchers import fetch_gcp
+
+FIXTURE = os.path.join(os.path.dirname(__file__), 'fixtures',
+                       'gcp_billing_skus.json')
+
+
+@pytest.fixture
+def billing_fixture(tmp_home, monkeypatch):
+    monkeypatch.setenv('SKYTPU_BILLING_FIXTURE', FIXTURE)
+    return tmp_home
+
+
+def test_tpu_sku_parsing():
+    pages = json.load(open(FIXTURE, encoding='utf-8'))
+    rows = fetch_gcp.fetch_tpu_prices(pages)
+    v5e = [r for r in rows if r['generation'] == 'v5e' and not r['spot']
+           and r['region'] == 'us-west4']
+    assert v5e and v5e[0]['price_chip_hr'] == pytest.approx(1.2)
+    v5p_spot = [r for r in rows if r['generation'] == 'v5p' and r['spot']]
+    assert v5p_spot and v5p_spot[0]['price_chip_hr'] == pytest.approx(1.89)
+
+
+def test_vm_unit_sku_parsing_skips_unrelated():
+    pages = json.load(open(FIXTURE, encoding='utf-8'))
+    units = fetch_gcp.fetch_vm_unit_prices(pages)
+    assert units[('n2', 'core', 'us-central1', False)] == pytest.approx(
+        0.031611)
+    assert units[('n2', 'ram', 'us-central1', True)] == pytest.approx(
+        0.001271)
+    # egress / GPU SKUs must not match the family regex
+    assert not any('egress' in k[0] or 'nvidia' in k[0] for k in units)
+
+
+def test_vm_price_derivation():
+    pages = json.load(open(FIXTURE, encoding='utf-8'))
+    units = fetch_gcp.fetch_vm_unit_prices(pages)
+    rows = fetch_gcp.derive_vm_rows(
+        units, [('n2-standard-4', 4.0, 16.0), ('unknown-family-2', 2, 8)])
+    assert len(rows) == 1                       # unknown family skipped
+    r = rows[0]
+    # 4 cores x $0.031611 + 16 GB x $0.004237
+    assert r['price_hr'] == pytest.approx(4 * 0.031611 + 16 * 0.004237,
+                                          abs=1e-4)
+    assert r['spot_price_hr'] < r['price_hr']
+
+
+def test_fetcher_main_writes_csvs_catalog_prefers_them(billing_fixture):
+    assert fetch_gcp.main() == 0
+    override = common.catalog_override_dir()
+    assert os.path.exists(os.path.join(override, 'gcp_tpus.csv'))
+    assert os.path.exists(os.path.join(override, 'gcp_vms.csv'))
+    assert os.path.exists(os.path.join(override,
+                                       'gcp_tpus.csv.meta.json'))
+    # The catalog now resolves to the refreshed file...
+    assert common.resolve_catalog_path('gcp_tpus.csv').startswith(override)
+    # ...and prices from the fixture flow through the public API.
+    from skypilot_tpu.catalog import gcp_catalog
+    gcp_catalog._tpu_df.invalidate()      # drop cache from other tests
+    gcp_catalog._vm_df.invalidate()
+    try:
+        cost = gcp_catalog.get_tpu_hourly_cost('tpu-v5p-8',
+                                               zone='us-east5-a')
+        assert cost == pytest.approx(4 * 4.2)   # v5p-8 = 8 cores = 4 chips
+        vm = gcp_catalog.get_vm_hourly_cost('n2-standard-4')
+        assert vm == pytest.approx(4 * 0.031611 + 16 * 0.004237, abs=1e-3)
+    finally:
+        gcp_catalog._tpu_df.invalidate()  # don't leak the override df
+        gcp_catalog._vm_df.invalidate()
+
+
+def test_fetcher_zones_come_from_bundled_not_invented(billing_fixture):
+    """Regions with no known zones in the bundled table are dropped (the
+    TPU locations API is the zone authority, billing is region-level)."""
+    assert fetch_gcp.main() == 0
+    import pandas as pd
+    df = pd.read_csv(os.path.join(common.catalog_override_dir(),
+                                  'gcp_tpus.csv'))
+    # europe-west9 (v5e in fixture) has no bundled zones -> dropped.
+    assert 'europe-west9' not in set(df['region'])
+    bundled = pd.read_csv(os.path.join(common._BUNDLED_DIR,
+                                       'gcp_tpus.csv'))
+    assert set(df['zone']) <= set(bundled['zone'])
