@@ -186,10 +186,14 @@ def bench_serve(on_tpu: bool) -> dict:
     while w.finished_at is None:
         engine.step()
 
+    # --- saturated regime: every request offered at t=0.  TTFT here is
+    # queueing-dominated by construction (48 requests into 8 slots);
+    # the honest interactive-latency numbers come from the sub-
+    # saturating Poisson regime below.
     reqs = [engine.submit(p, new_tokens) for p in prompts]
     t0 = time.perf_counter()
     while any(r.finished_at is None for r in reqs):
-        engine.step()
+        engine.step_pipelined()
     wall = time.perf_counter() - t0
 
     out_tokens = sum(r.emitted for r in reqs)
@@ -201,6 +205,35 @@ def bench_serve(on_tpu: bool) -> dict:
                          (r.emitted - 1))
     tpots.sort()
     out_tok_per_s = out_tokens / wall
+
+    # --- sub-saturating regime: Poisson arrivals at 0.7x measured
+    # capacity; the engine runs its own pipelined loop thread.
+    poisson_n = max(8, n_requests // 2)
+    rate = 0.7 * out_tok_per_s / new_tokens          # req/s offered
+    engine.start()
+    try:
+        arr_rng = np.random.default_rng(1)
+        gaps = arr_rng.exponential(1.0 / rate, poisson_n)
+        p_reqs = []
+        p_t0 = time.perf_counter()
+        for i in range(poisson_n):
+            target = p_t0 + float(np.sum(gaps[:i + 1]))
+            dt = target - time.perf_counter()
+            if dt > 0:
+                time.sleep(dt)
+            p_reqs.append(engine.submit(
+                prompts[i % len(prompts)], new_tokens))
+        deadline = time.perf_counter() + 300
+        while any(r.finished_at is None for r in p_reqs) and \
+                time.perf_counter() < deadline:
+            time.sleep(0.05)
+    finally:
+        engine.stop()
+    p_ttfts = sorted((r.first_token_at - r.submitted_at) * 1e3
+                     for r in p_reqs if r.first_token_at is not None)
+    p_tpots = sorted(
+        (r.finished_at - r.first_token_at) * 1e3 / (r.emitted - 1)
+        for r in p_reqs if r.finished_at is not None and r.emitted > 1)
     kind = _chip_kind()
     base = _SERVE_BASELINE
     per_chip_base = base['out_tok_per_s'] / base['n_chips']
@@ -213,6 +246,13 @@ def bench_serve(on_tpu: bool) -> dict:
         'out_tok_per_s': round(out_tok_per_s, 1),
         'ttft_median_ms': round(ttfts[len(ttfts) // 2], 2),
         'tpot_median_ms': round(tpots[len(tpots) // 2], 2),
+        # Sub-saturating (0.7x capacity, Poisson arrivals): the latency
+        # a real user sees when the service is provisioned sanely.
+        'poisson_load_frac': 0.7,
+        'poisson_ttft_median_ms': round(
+            p_ttfts[len(p_ttfts) // 2], 2) if p_ttfts else None,
+        'poisson_tpot_median_ms': round(
+            p_tpots[len(p_tpots) // 2], 2) if p_tpots else None,
         'n_slots': n_slots,
         'prompt_len': prompt_len,
         'new_tokens': new_tokens,

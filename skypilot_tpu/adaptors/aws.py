@@ -16,6 +16,7 @@ from skypilot_tpu import exceptions
 
 _lock = threading.Lock()
 _sessions: Dict[tuple, Any] = {}
+_clients: Dict[tuple, Any] = {}
 
 
 def boto3():
@@ -39,13 +40,29 @@ def session(region: Optional[str] = None):
 
 
 def client(service: str, region: Optional[str] = None):
-    return session(region).client(service)
+    """Cached per (service, region), CREATED under the lock: boto3
+    sessions are not thread-safe to create clients from concurrently
+    (botocore's loader/credential-resolver race); the finished client
+    objects are thread-safe to share."""
+    key = (service, region)
+    with _lock:
+        if key not in _clients:
+            if (None, region) not in _sessions:
+                _sessions[(None, region)] = boto3().session.Session(
+                    region_name=region)
+            _clients[key] = _sessions[(None, region)].client(service)
+        return _clients[key]
 
 
 def resource(service: str, region: Optional[str] = None):
-    return session(region).resource(service)
+    with _lock:
+        if (None, region) not in _sessions:
+            _sessions[(None, region)] = boto3().session.Session(
+                region_name=region)
+        return _sessions[(None, region)].resource(service)
 
 
 def reset_cache_for_tests() -> None:
     with _lock:
         _sessions.clear()
+        _clients.clear()

@@ -49,8 +49,8 @@ def get_vm_hourly_cost(instance_type: str,
         raise exceptions.ResourcesUnavailableError(
             f'{instance_type} is not offered in {where} '
             f'(AWS catalog).')
-    r = rows.sort_values('price_hr').iloc[0]
-    return float(r['spot_price_hr'] if use_spot else r['price_hr'])
+    col = 'spot_price_hr' if use_spot else 'price_hr'
+    return float(rows.sort_values(col).iloc[0][col])
 
 
 def get_default_instance_type(cpus: Optional[str] = None,

@@ -97,9 +97,21 @@ class AWS(cloud_lib.Cloud):
             return True, None
         if _aws_config_has_credentials():
             return True, None
+        # Profile / SSO / assumed-role setups: no static keys anywhere,
+        # but ~/.aws/config carries the profile and boto3 resolves it.
+        config_path = os.path.expanduser(
+            os.environ.get('AWS_CONFIG_FILE', '~/.aws/config'))
+        if os.environ.get('AWS_PROFILE') or os.path.exists(config_path):
+            try:
+                from skypilot_tpu.adaptors import aws as aws_adaptor
+                creds = aws_adaptor.session().get_credentials()
+                if creds is not None:
+                    return True, None
+            except Exception:  # pylint: disable=broad-except
+                pass
         return False, ('No AWS credentials found. Set AWS_ACCESS_KEY_ID/'
-                       'AWS_SECRET_ACCESS_KEY or populate '
-                       '~/.aws/credentials (aws configure).')
+                       'AWS_SECRET_ACCESS_KEY, run `aws configure`, or '
+                       'configure a profile/SSO in ~/.aws/config.')
 
     def check_storage_credentials(self, compute_result=None) -> tuple:
         if os.environ.get('SKYTPU_FAKE_S3_ROOT'):

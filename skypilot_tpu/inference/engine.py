@@ -87,7 +87,7 @@ class Request:
 
 
 class _Slot:
-    __slots__ = ('request', 'length', 'first_pending')
+    __slots__ = ('request', 'length', 'first_pending', 'done')
 
     def __init__(self, request: Request, length: int) -> None:
         self.request = request
@@ -95,6 +95,11 @@ class _Slot:
         # True until the prefill-sampled first token has been emitted
         # (it arrives as row 0 of the next decode call's output).
         self.first_pending = True
+        # Finished (retired); set on the SLOT object so a pipelined
+        # in-flight call's snapshot can tell "emit this slot's remaining
+        # rows" (handoff: a successor was admitted into the slot index)
+        # from "this slot's rows are retire-lag garbage".
+        self.done = False
 
 
 class DecodeEngine:
@@ -124,6 +129,9 @@ class DecodeEngine:
         # blocks forever.
         self._submit_lock = threading.Lock()
         self._slots: List[Optional[_Slot]] = [None] * config.n_slots
+        # In-flight decode call (pipelined loop): (device out, snapshot
+        # of the slots it covers).  Processed one iteration later.
+        self._inflight = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.error: Optional[BaseException] = None
@@ -428,17 +436,27 @@ class DecodeEngine:
         return (tok == self.cfg.eos_id or
                 slot.request.emitted >= slot.request.max_new_tokens)
 
-    def _retire(self, slot_id: int) -> None:
-        slot = self._slots[slot_id]
+    def _retire(self, slot_id: int, slot: Optional[_Slot] = None) -> None:
+        slot = slot if slot is not None else self._slots[slot_id]
+        slot.done = True
         slot.request.finished_at = time.perf_counter()
         slot.request.out.put(None)
-        self._slots[slot_id] = None
+        # Under handoff a successor may already occupy the index — only
+        # clear the mapping when it still points at the finished slot.
+        if self._slots[slot_id] is slot:
+            self._slots[slot_id] = None
 
-    def step(self) -> int:
-        """One engine iteration (admit + decode).  Returns #active slots.
-        Exposed for tests and for single-threaded benchmarking."""
+    def _admit_free(self, handoff: Optional[List[int]] = None) -> None:
+        """Admit queued requests into free slots (grouped per bucket —
+        one fused prefill dispatch per group).  ``handoff`` lists slot
+        indices whose occupant is guaranteed to finish during the
+        IN-FLIGHT decode call: their successors' prefill+insert queues
+        behind that call on device, so the slot turns over with zero
+        garbage calls (the in-flight snapshot still emits the finishing
+        occupant's rows — see _Slot.done)."""
         free = [i for i in range(self.cfg.n_slots)
                 if self._slots[i] is None]
+        free += [i for i in (handoff or []) if self._slots[i] is not None]
         by_bucket: Dict[int, list] = {}
         while free and not self._prefill_q.empty():
             try:
@@ -450,6 +468,13 @@ class DecodeEngine:
                     (free.pop(0), req))
         for bucket, group in by_bucket.items():
             self._admit_group(bucket, group)
+
+    def step(self) -> int:
+        """One SYNCHRONOUS engine iteration (admit + decode + process).
+        Returns #active slots.  Exposed for tests and debugging; the
+        serving loop and benchmarks use step_pipelined, which overlaps
+        the host work with the next device call."""
+        self._admit_free()
         active = [i for i in range(self.cfg.n_slots)
                   if self._slots[i] is not None]
         if not active:
@@ -458,9 +483,70 @@ class DecodeEngine:
             self.params, self._cache, self._last_d, self._lens_d,
             self._next_rng())
         out = np.asarray(out)            # [T+1, B] — the ONE sync per step
+        self._process_rows(out, {i: self._slots[i] for i in active})
+        return len(active)
+
+    def step_pipelined(self) -> int:
+        """One PIPELINED iteration: dispatch decode call k, THEN sync and
+        process call k-1's output while k runs on device, then admit
+        into any slots k-1 freed (their prefills queue behind k).
+
+        The device therefore never idles between calls — the host's
+        token emission, retire bookkeeping and the dispatch round-trip
+        (about a full RPC on tunneled control planes) all hide under
+        call k's compute.  The price is a one-call lag: a slot that
+        finishes inside call k keeps decoding garbage through call k+1
+        (discarded by _process_rows' snapshot identity check, bounded at
+        steps_per_call tokens), and an admission waits one extra call
+        before its first token.  At saturation the throughput win
+        dominates; TTFT under light load pays ~one call of latency.
+
+        Returns #slots active in the dispatched call (0 = fully idle and
+        nothing in flight).
+        """
+        active = [i for i in range(self.cfg.n_slots)
+                  if self._slots[i] is not None]
+        dispatched = None
+        if active:
+            out_d, self._cache, self._last_d, self._lens_d = self._decode(
+                self.params, self._cache, self._last_d, self._lens_d,
+                self._next_rng())
+            dispatched = (out_d, {i: self._slots[i] for i in active})
+        if self._inflight is not None:
+            out_prev, snapshot = self._inflight
+            self._inflight = None
+            self._process_rows(np.asarray(out_prev), snapshot)
+        self._inflight = dispatched
+        # Admissions AFTER processing: retired slots are free now, and
+        # slots whose occupant will PROVABLY finish inside the call just
+        # dispatched (its remaining max_new fits the rows that call
+        # delivers) hand off to a successor with zero garbage calls —
+        # the successor's prefill queues behind the in-flight call.
+        handoff = []
+        if dispatched is not None:
+            steps = self.cfg.steps_per_call
+            for i, slot in dispatched[1].items():
+                if self._slots[i] is not slot or slot.done:
+                    continue
+                rows_to_come = steps + (1 if slot.first_pending else 0)
+                remaining = (slot.request.max_new_tokens -
+                             slot.request.emitted)
+                if remaining <= rows_to_come:
+                    handoff.append(i)
+        self._admit_free(handoff)
+        return len(active)
+
+    def _process_rows(self, out: np.ndarray, snapshot: Dict[int, _Slot]
+                      ) -> None:
+        """Emit one decode call's tokens to the slots captured at its
+        DISPATCH time.  A slot whose occupant changed since (retired, or
+        retired-and-readmitted under pipelining) is skipped by object
+        identity — its rows are the bounded garbage of the one-call
+        retire lag, never another request's tokens."""
         now = time.perf_counter()
-        for i in active:
-            slot = self._slots[i]
+        for i, slot in snapshot.items():
+            if slot.done:
+                continue                 # retired earlier: rows are garbage
             start = 0
             if slot.first_pending:
                 slot.first_pending = False
@@ -472,14 +558,14 @@ class DecodeEngine:
                 slot.length += 1
                 self._emit(slot.request, tok)
                 if self._finished(slot, tok):
-                    self._retire(i)
+                    self._retire(i, slot)
                     break                # rest of this call's tokens: waste
-        return len(active)
+
 
     def _loop(self):
         while not self._stop.is_set():
             try:
-                n = self.step()
+                n = self.step_pipelined()
             except BaseException as e:  # pylint: disable=broad-except
                 # A dead loop thread must not strand callers: fail every
                 # in-flight and queued request, flip unhealthy (the HTTP

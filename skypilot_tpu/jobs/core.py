@@ -127,6 +127,16 @@ def launch(task_or_dag, name: Optional[str] = None) -> int:
     if not tasks:
         raise exceptions.InvalidDagError('managed job needs >= 1 task')
     if _controller_mode() == 'vm':
+        for t in tasks:
+            local_mounts = [src for src in (t.file_mounts or {}).values()
+                            if isinstance(src, str) and
+                            not src.startswith(('gs://', 's3://'))]
+            if t.workdir or local_mounts:
+                raise exceptions.InvalidTaskError(
+                    'dedicated-controller (vm) mode cannot ship local '
+                    'workdir/file_mounts to the controller host yet; '
+                    'upload them to a bucket and use storage mounts '
+                    '(gs://... / s3://...), or use consolidation mode.')
         _ensure_controller_cluster()
         spec = {'name': job_name,
                 'tasks': [t.to_yaml_config() for t in tasks]}
@@ -159,8 +169,11 @@ def queue(refresh: bool = False,
     if _controller_mode() == 'vm' and \
             global_user_state.get_cluster(
                 JOBS_CONTROLLER_CLUSTER) is not None:
-        return _remote_call(['queue',
-                             '1' if all_users else '0'])['jobs']
+        records = _remote_call(['queue', '1' if all_users else '0'])['jobs']
+        # Same shape as the consolidation path: callers (REST handler,
+        # CLI tables) expect enum statuses.
+        return [dict(r, status=state.ManagedJobStatus(r['status']))
+                for r in records]
     from skypilot_tpu import users as users_lib
     from skypilot_tpu import workspaces as workspaces_lib
     records = [r for r in state.list_jobs()
@@ -226,16 +239,18 @@ def tail_logs(job_id: int, follow: bool = True, out=None) -> int:
         import sys
         import time as time_lib
         stream = out or sys.stdout
-        emitted = 0
+        offset = 0
         while True:
-            result = _remote_call(['logs', str(job_id)])
+            # Offset rides to the remote verb so each poll ships only
+            # the delta (not O(len(log)) per poll).
+            result = _remote_call(['logs', str(job_id), str(offset)])
             if 'error' in result:
                 raise exceptions.JobNotFoundError(f'managed job {job_id}')
             text = result.get('logs', '')
-            if len(text) > emitted:
-                stream.write(text[emitted:])
+            if text:
+                stream.write(text)
                 stream.flush()
-                emitted = len(text)
+            offset = int(result.get('offset', offset))
             status = state.ManagedJobStatus(result['status'])
             if status.is_terminal():
                 return 0 if status is \

@@ -93,12 +93,19 @@ def main(argv) -> int:
         if rec is None:
             _emit({'error': 'not found'})
             return 1
+        offset = int(argv[2]) if len(argv) > 2 else 0
         path = state.log_path(rec['job_id'])
         text = ''
         if os.path.exists(path):
-            with open(path, 'r', errors='replace') as f:
-                text = f.read()
-        _emit({'logs': text, 'status': rec['status'].value})
+            # Byte offsets (binary read): char counts drift on non-UTF8
+            # bytes under errors='replace'.
+            with open(path, 'rb') as f:
+                f.seek(offset)
+                raw = f.read()
+            text = raw.decode(errors='replace')
+            offset += len(raw)
+        _emit({'logs': text, 'offset': offset,
+               'status': rec['status'].value})
     else:
         _emit({'error': f'unknown verb {verb}'})
         return 2

@@ -160,12 +160,14 @@ class Ec2Client:
         if ids:
             self._boto_call('stop_instances', InstanceIds=ids)
 
-    def start(self, cluster_name: str) -> None:
+    def start(self, cluster_name: str,
+              names: Optional[List[str]] = None) -> None:
         if self._fake_endpoint:
             self._fake('POST', '/start', body={'region': self.region,
-                                               'cluster': cluster_name})
+                                               'cluster': cluster_name,
+                                               'names': names})
             return
-        ids = self._ids_for(cluster_name)
+        ids = self._ids_for(cluster_name, names)
         if ids:
             self._boto_call('start_instances', InstanceIds=ids)
 

@@ -77,7 +77,10 @@ def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
             resumed = True
             continue
         if state in ('stopped', 'stopping'):
-            client.start(config.cluster_name)
+            # THIS node only: starting the whole cluster tag would also
+            # resurrect nodes beyond num_nodes (e.g. a shrunk relaunch),
+            # which nothing would track or ever stop again.
+            client.start(config.cluster_name, names=[name])
             resumed = True
             continue
         if state == 'shutting-down':

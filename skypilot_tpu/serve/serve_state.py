@@ -208,13 +208,26 @@ def set_replica_status_if(service_name: str, replica_id: int,
                           expected: ReplicaStatus,
                           status: ReplicaStatus) -> bool:
     """Atomic guarded transition; False if the replica was not in
-    `expected` (e.g. terminated while its launch thread was running)."""
+    `expected` (e.g. terminated while its launch thread was running).
+
+    Entering STARTING re-stamps launched_at: the readiness initial-delay
+    grace must start when the replica's JOB starts, not when its row was
+    created — provisioning (minutes on real clouds) would otherwise eat
+    the whole readiness budget and every slow provision would be
+    replaced the moment it finally came up."""
     path = _ensure()
     with db_utils.transaction(path) as conn:
-        cur = conn.execute(
-            'UPDATE replicas SET status=? WHERE service_name=? AND '
-            'replica_id=? AND status=?',
-            (status.value, service_name, replica_id, expected.value))
+        if status is ReplicaStatus.STARTING:
+            cur = conn.execute(
+                'UPDATE replicas SET status=?, launched_at=? '
+                'WHERE service_name=? AND replica_id=? AND status=?',
+                (status.value, time.time(), service_name, replica_id,
+                 expected.value))
+        else:
+            cur = conn.execute(
+                'UPDATE replicas SET status=? WHERE service_name=? AND '
+                'replica_id=? AND status=?',
+                (status.value, service_name, replica_id, expected.value))
         return cur.rowcount > 0
 
 

@@ -60,18 +60,31 @@ _E2E_MODULES = {
     'test_serve', 'test_server_daemons', 'test_ssh_gang',
     'test_transfer_logs',
 }
-def pytest_configure(config):
-    """Honor the xdist_group markers automatically: when xdist is active
-    with its default scheduler, switch to loadgroup.  Done here (not in
-    addopts) so bare `pytest` works in environments without pytest-xdist
-    — `--dist` is an xdist-registered option."""
-    if (config.pluginmanager.hasplugin('xdist') and
-            getattr(config.option, 'numprocesses', None) and
-            getattr(config.option, 'dist', 'no') == 'load'):
-        config.option.dist = 'loadgroup'
+def pytest_addoption(parser):
+    """Keep bare `pytest` working without pytest-xdist: addopts carries
+    `--dist loadgroup` (the only transport that reaches xdist WORKERS),
+    which is an xdist-registered option — register a no-op stand-in when
+    the plugin is absent."""
+    import sys
+    argv_blob = ' '.join(sys.argv) + ' ' + os.environ.get(
+        'PYTEST_ADDOPTS', '')
+    disabled = 'no:xdist' in argv_blob    # -p no:xdist / -pno:xdist / env
+    try:
+        import xdist  # noqa: F401  pylint: disable=unused-import
+    except ImportError:
+        disabled = True
+    if disabled:
+        parser.addoption('--dist', action='store', default='no',
+                         help='no-op (pytest-xdist not installed)')
 
 
+@pytest.hookimpl(tryfirst=True)
 def pytest_collection_modifyitems(config, items):
+    # tryfirst: xdist's WorkerInteractor also hooks modifyitems to bake
+    # the xdist_group into each nodeid (remote.py:242) and, being
+    # registered after conftest plugins, runs BEFORE this hook by
+    # default — the lane markers must exist by then or loadgroup
+    # silently degrades to plain load scheduling.
     for item in items:
         stem = item.path.stem if hasattr(item, 'path') else ''
         if stem in _CHAOS_MODULES:

@@ -242,9 +242,10 @@ def test_named_s3_storage_mount_materializes(fake_s3, tmp_path):
 def test_aws_credential_check_modes(monkeypatch):
     cloud = get_cloud('aws')
     for var in ('SKYTPU_EC2_API_ENDPOINT', 'AWS_ACCESS_KEY_ID',
-                'AWS_SECRET_ACCESS_KEY'):
+                'AWS_SECRET_ACCESS_KEY', 'AWS_PROFILE'):
         monkeypatch.delenv(var, raising=False)
     monkeypatch.setenv('AWS_SHARED_CREDENTIALS_FILE', '/nonexistent')
+    monkeypatch.setenv('AWS_CONFIG_FILE', '/nonexistent')
     ok, reason = cloud.check_credentials()
     assert not ok and 'credentials' in reason.lower()
     monkeypatch.setenv('AWS_ACCESS_KEY_ID', 'AKIATEST')

@@ -53,11 +53,19 @@ def test_catalog_staleness(tmp_home, monkeypatch):
 
 
 def test_catalog_staleness_endpoint(api_server):
+    import requests as requests_lib
     from skypilot_tpu.client import sdk
     st = sdk.catalog_staleness()
     assert 'gcp_tpus.csv' in st and 'stale' in st['gcp_tpus.csv']
-    # /check keeps its every-entry-is-a-cloud shape for old clients.
-    for info in sdk.check().values():
+    # Raw /check (no opt-in param, what RELEASED clients send) keeps its
+    # every-entry-is-a-cloud shape; the reserved '_warnings' key appears
+    # only for clients that ask for it (this SDK does).
+    raw = requests_lib.get(f'{api_server}/check', timeout=30).json()
+    for info in raw.values():
+        assert 'enabled' in info
+    result = sdk.check()
+    assert isinstance(result.pop('_warnings', []), list)
+    for info in result.values():
         assert 'enabled' in info
 
 
