@@ -279,6 +279,12 @@ def main() -> None:
     # memory linear in S; the reference publishes nothing at this axis).
     train_8k = bench_train(on_tpu, seq=8192 if on_tpu else 128,
                            batch=2, steps=8 if on_tpu else 2)
+    # Drop the train executables before serving: compiled TPU programs
+    # (two big train graphs) hold HBM, and the 7B serve section needs
+    # 13.3 GB of params + cache on a 16 GB chip.
+    import gc
+    jax.clear_caches()
+    gc.collect()
     serve = bench_serve(on_tpu)
     print(json.dumps({
         'metric': 'llama_train_mfu_single_chip',

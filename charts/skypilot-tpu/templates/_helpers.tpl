@@ -1,0 +1,15 @@
+{{- define "skypilot-tpu.fullname" -}}
+{{- printf "%s" .Release.Name | trunc 63 | trimSuffix "-" -}}
+{{- end -}}
+
+{{- define "skypilot-tpu.labels" -}}
+app.kubernetes.io/name: skypilot-tpu
+app.kubernetes.io/instance: {{ .Release.Name }}
+app.kubernetes.io/version: {{ .Chart.AppVersion }}
+app.kubernetes.io/managed-by: {{ .Release.Service }}
+{{- end -}}
+
+{{- define "skypilot-tpu.selectorLabels" -}}
+app.kubernetes.io/name: skypilot-tpu
+app.kubernetes.io/instance: {{ .Release.Name }}
+{{- end -}}

@@ -31,12 +31,17 @@ def boto3():
             'SKYTPU_FAKE_S3_ROOT) and do not need it.') from e
 
 
-def session(region: Optional[str] = None):
+def _session_locked(region: Optional[str]):
+    """Caller must hold _lock."""
     key = (None, region)
+    if key not in _sessions:
+        _sessions[key] = boto3().session.Session(region_name=region)
+    return _sessions[key]
+
+
+def session(region: Optional[str] = None):
     with _lock:
-        if key not in _sessions:
-            _sessions[key] = boto3().session.Session(region_name=region)
-        return _sessions[key]
+        return _session_locked(region)
 
 
 def client(service: str, region: Optional[str] = None):
@@ -47,19 +52,16 @@ def client(service: str, region: Optional[str] = None):
     key = (service, region)
     with _lock:
         if key not in _clients:
-            if (None, region) not in _sessions:
-                _sessions[(None, region)] = boto3().session.Session(
-                    region_name=region)
-            _clients[key] = _sessions[(None, region)].client(service)
+            _clients[key] = _session_locked(region).client(service)
         return _clients[key]
 
 
 def resource(service: str, region: Optional[str] = None):
+    key = ('resource', service, region)
     with _lock:
-        if (None, region) not in _sessions:
-            _sessions[(None, region)] = boto3().session.Session(
-                region_name=region)
-        return _sessions[(None, region)].resource(service)
+        if key not in _clients:
+            _clients[key] = _session_locked(region).resource(service)
+        return _clients[key]
 
 
 def reset_cache_for_tests() -> None:

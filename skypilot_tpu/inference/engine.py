@@ -574,11 +574,24 @@ class DecodeEngine:
                 logger.exception('decode engine loop crashed')
                 with self._submit_lock:
                     self.error = e
+                    # Fail the in-flight snapshot FIRST: a handed-off
+                    # slot's old occupant lives only there (replaced in
+                    # _slots but not finished) and would otherwise
+                    # strand its caller in Request.tokens() forever.
+                    if self._inflight is not None:
+                        for slot in self._inflight[1].values():
+                            if not slot.done:
+                                slot.done = True
+                                slot.request.finished_at = \
+                                    time.perf_counter()
+                                slot.request.out.put(None)
+                        self._inflight = None
                     for i, slot in enumerate(self._slots):
-                        if slot is not None:
+                        if slot is not None and not slot.done:
+                            slot.done = True
                             slot.request.finished_at = time.perf_counter()
                             slot.request.out.put(None)
-                            self._slots[i] = None
+                        self._slots[i] = None
                     while True:
                         try:
                             req = self._prefill_q.get_nowait()

@@ -247,10 +247,20 @@ def tail_logs(job_id: int, follow: bool = True, out=None) -> int:
             if 'error' in result:
                 raise exceptions.JobNotFoundError(f'managed job {job_id}')
             text = result.get('logs', '')
-            if text:
-                stream.write(text)
-                stream.flush()
-            offset = int(result.get('offset', offset))
+            if 'offset' in result:
+                if text:
+                    stream.write(text)
+                    stream.flush()
+                offset = int(result['offset'])
+            elif text:
+                # Controller cluster still running a pre-offset runtime
+                # (it is reused while UP; runtime re-syncs at launch):
+                # it returns the FULL log each poll — dedupe client-side
+                # by character count.
+                if len(text) > offset:
+                    stream.write(text[offset:])
+                    stream.flush()
+                    offset = len(text)
             status = state.ManagedJobStatus(result['status'])
             if status.is_terminal():
                 return 0 if status is \

@@ -67,6 +67,7 @@ def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
                 client.list_instances(config.cluster_name)}
     instance_ids = []
     to_create = []
+    to_start = []
     resumed = False
     for i in range(config.num_nodes):
         name = _node_id(config.cluster_name, i)
@@ -77,10 +78,10 @@ def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
             resumed = True
             continue
         if state in ('stopped', 'stopping'):
-            # THIS node only: starting the whole cluster tag would also
-            # resurrect nodes beyond num_nodes (e.g. a shrunk relaunch),
-            # which nothing would track or ever stop again.
-            client.start(config.cluster_name, names=[name])
+            # Only in-range nodes (starting the whole cluster tag would
+            # also resurrect nodes beyond num_nodes on a shrunk
+            # relaunch), batched into ONE start call after the loop.
+            to_start.append(name)
             resumed = True
             continue
         if state == 'shutting-down':
@@ -93,6 +94,8 @@ def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
                     break
                 time.sleep(_poll_s(2.0))
         to_create.append(name)
+    if to_start:
+        client.start(config.cluster_name, names=to_start)
     if to_create:
         user_data = None
         if config.authorized_key:

@@ -54,28 +54,22 @@ _MODEL_MODULES = {
 }
 _E2E_MODULES = {
     'test_agent_events', 'test_api_server', 'test_autostop',
-    'test_client_server_compat', 'test_controller_vm',
+    'test_backward_compat', 'test_client_server_compat',
+    'test_controller_vm',
     'test_dashboard_misc', 'test_docker_runtime', 'test_execution_e2e',
     'test_fuse_proxy', 'test_managed_jobs', 'test_multiworker',
     'test_serve', 'test_server_daemons', 'test_ssh_gang',
     'test_transfer_logs',
 }
-def pytest_addoption(parser):
+def pytest_addoption(parser, pluginmanager):
     """Keep bare `pytest` working without pytest-xdist: addopts carries
     `--dist loadgroup` (the only transport that reaches xdist WORKERS),
-    which is an xdist-registered option — register a no-op stand-in when
-    the plugin is absent."""
-    import sys
-    argv_blob = ' '.join(sys.argv) + ' ' + os.environ.get(
-        'PYTEST_ADDOPTS', '')
-    disabled = 'no:xdist' in argv_blob    # -p no:xdist / -pno:xdist / env
-    try:
-        import xdist  # noqa: F401  pylint: disable=unused-import
-    except ImportError:
-        disabled = True
-    if disabled:
+    which is an xdist-registered option — register a no-op stand-in
+    whenever the real plugin is not loaded (absent, `-p no:xdist`,
+    PYTEST_DISABLE_PLUGIN_AUTOLOAD, ...)."""
+    if not pluginmanager.hasplugin('xdist'):
         parser.addoption('--dist', action='store', default='no',
-                         help='no-op (pytest-xdist not installed)')
+                         help='no-op (pytest-xdist not loaded)')
 
 
 @pytest.hookimpl(tryfirst=True)
