@@ -57,11 +57,11 @@ def client(service: str, region: Optional[str] = None):
 
 
 def resource(service: str, region: Optional[str] = None):
-    key = ('resource', service, region)
+    """A FRESH resource per call (created under the lock): boto3
+    documents resources — unlike clients — as not safe to share across
+    threads."""
     with _lock:
-        if key not in _clients:
-            _clients[key] = _session_locked(region).resource(service)
-        return _clients[key]
+        return _session_locked(region).resource(service)
 
 
 def reset_cache_for_tests() -> None:

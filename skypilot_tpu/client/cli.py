@@ -246,6 +246,29 @@ def check():
         click.echo(f'  catalog {fn}: {state}')
 
 
+@cli.command('rotate-keys')
+def rotate_keys():
+    """Rotate the framework SSH keypair across every UP cluster.
+
+    Pushes the new public key over the old credentials first, swaps the
+    local keypair only after every reachable cluster accepted it, and
+    keeps a timestamped backup of the old private key.  Runs client-side
+    (key material never transits the API server)."""
+    from skypilot_tpu import authentication
+    from skypilot_tpu import exceptions as exc
+    try:
+        result = authentication.rotate_keys()
+    except exc.SkyTpuError as e:
+        click.secho(str(e), fg='red', err=True)
+        raise SystemExit(1)
+    for name in result['rotated']:
+        click.echo(f'  rotated: {name}')
+    for entry in result['skipped']:
+        click.echo(f'  skipped: {entry}')
+    click.echo('Key rotation complete; old key backed up as '
+               f'{authentication.PRIVATE_KEY_PATH}.<stamp>.bak')
+
+
 @cli.command('plan')
 @click.option('--accelerator', required=True,
               help='Target slice, e.g. tpu-v5p-256 (xN for multislice).')

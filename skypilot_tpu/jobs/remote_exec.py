@@ -105,19 +105,25 @@ def main(argv) -> int:
             # A poll can catch the writer mid-character: hold back an
             # incomplete trailing UTF-8 sequence (it rides the next
             # poll) instead of permanently rendering it as U+FFFD.
-            trim = 0
-            for trim in range(1, min(4, len(raw)) + 1):
-                byte = raw[-trim]
-                if byte < 0x80:
-                    trim = 0
-                    break
-                if byte >= 0xC0:          # lead byte of the sequence
-                    need = (2 if byte < 0xE0 else
-                            3 if byte < 0xF0 else 4)
-                    trim = trim if trim < need else 0
-                    break
-            if trim:
-                raw = raw[:-trim]
+            # Never hold back on a TERMINAL job (there is no next poll:
+            # invalid trailing bytes must surface as U+FFFD, not vanish)
+            # and never hold back bytes that cannot be a UTF-8 prefix
+            # (>=4 trailing continuation bytes = just invalid data).
+            if not rec['status'].is_terminal():
+                trim = 0
+                scanned = 0
+                for scanned in range(1, min(4, len(raw)) + 1):
+                    byte = raw[-scanned]
+                    if byte < 0x80:
+                        break
+                    if byte >= 0xC0:      # lead byte of the sequence
+                        need = (2 if byte < 0xE0 else
+                                3 if byte < 0xF0 else 4)
+                        if scanned < need:
+                            trim = scanned
+                        break
+                if trim:
+                    raw = raw[:-trim]
             text = raw.decode(errors='replace')
             offset += len(raw)
         _emit({'logs': text, 'offset': offset,

@@ -53,9 +53,9 @@ _MODEL_MODULES = {
     'test_placement_validate',
 }
 _E2E_MODULES = {
-    'test_agent_events', 'test_api_server', 'test_autostop',
-    'test_backward_compat', 'test_client_server_compat',
-    'test_controller_vm',
+    'test_agent_events', 'test_api_server', 'test_authentication',
+    'test_autostop', 'test_backward_compat',
+    'test_client_server_compat', 'test_controller_vm',
     'test_dashboard_misc', 'test_docker_runtime', 'test_execution_e2e',
     'test_fuse_proxy', 'test_managed_jobs', 'test_multiworker',
     'test_serve', 'test_server_daemons', 'test_ssh_gang',

@@ -1,0 +1,107 @@
+"""Key generation + rotation (parity target: sky/authentication.py's
+per-cloud distribution; rotation is a greenfield capability).
+
+Rotation runs against a cluster whose "remote" hosts are reached through
+the loopback ssh shim (tests/test_ssh_gang.py pattern), so the real
+SSHCommandRunner path — including the idempotent authorized_keys append
+— is the code under test.
+"""
+import os
+import stat
+
+import pytest
+
+from skypilot_tpu import authentication
+from skypilot_tpu import global_user_state
+from skypilot_tpu.global_user_state import ClusterHandle, ClusterStatus
+
+
+@pytest.fixture
+def ssh_shim(tmp_path, monkeypatch):
+    shim_dir = tmp_path / 'shim'
+    shim_dir.mkdir()
+    shim = shim_dir / 'ssh'
+    shim.write_text('''#!/usr/bin/env bash
+args=()
+while [[ $# -gt 0 ]]; do
+  case "$1" in
+    -o|-p|-i) shift 2 ;;
+    -T|-tt) shift ;;
+    *) args+=("$1"); shift ;;
+  esac
+done
+unset 'args[0]'
+exec bash -c "${args[*]}"
+''')
+    shim.chmod(shim.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv('PATH',
+                       f'{shim_dir}{os.pathsep}{os.environ["PATH"]}')
+
+
+def test_generate_idempotent(tmp_home):
+    priv1, pub1 = authentication.get_or_generate_keys()
+    priv2, pub2 = authentication.get_or_generate_keys()
+    assert (priv1, pub1) == (priv2, pub2)
+    assert pub1.startswith('ssh-ed25519')
+
+
+def test_rotate_pushes_then_swaps(tmp_home, ssh_shim):
+    _, old_pub = authentication.get_or_generate_keys()
+    priv = os.path.expanduser(authentication.PRIVATE_KEY_PATH)
+    # An "UP remote cluster" whose host is loopback via the shim; the
+    # framework key identifies it as ours to rotate.
+    handle = ClusterHandle('rotc', 'gcp', 'us-east5', 'us-east5-a',
+                           {'accelerators': 'tpu-v5e-8'}, 1,
+                           [['127.0.0.1']], ['rotc-0'],
+                           ssh_user=os.environ.get('USER', 'root'),
+                           ssh_key_path=priv)
+    global_user_state.add_or_update_cluster('rotc', handle,
+                                            ClusterStatus.UP)
+    # A BYO-key cluster must be skipped, not touched.
+    handle2 = ClusterHandle('byo', 'ssh', 'pool', 'pool',
+                            {'infra': 'ssh'}, 1, [['127.0.0.1']],
+                            ['byo-0'], ssh_user='x',
+                            ssh_key_path='/somewhere/else/id')
+    global_user_state.add_or_update_cluster('byo', handle2,
+                                            ClusterStatus.UP)
+
+    result = authentication.rotate_keys()
+    assert 'rotc' in result['rotated']
+    assert any(s.startswith('byo:') for s in result['skipped'])
+
+    _, new_pub = authentication.get_or_generate_keys()
+    assert new_pub != old_pub
+    # The shim executed the append against THIS host's authorized_keys.
+    auth_file = os.path.expanduser('~/.ssh/authorized_keys')
+    content = open(auth_file, encoding='utf-8').read()
+    assert new_pub in content
+    # Old key backed up, exactly one .bak pair.
+    backups = [f for f in os.listdir(os.path.dirname(priv))
+               if f.startswith('sky-key.') and f.endswith('.bak')]
+    assert len(backups) == 2        # priv + pub
+
+    # Idempotence: rotating again does not duplicate authorized_keys
+    # lines for keys already present.
+    result2 = authentication.rotate_keys()
+    assert 'rotc' in result2['rotated']
+    content2 = open(auth_file, encoding='utf-8').read()
+    assert content2.count(new_pub) == 1
+
+
+def test_rotate_aborts_on_unreachable_framework_keyed_cluster(tmp_home):
+    """A STOPPED cluster that depends on the framework key blocks the
+    rotation entirely (its hosts cannot receive the new key, and a later
+    restart does not re-inject metadata keys): nothing may be swapped."""
+    from skypilot_tpu import exceptions
+    priv, old_pub = authentication.get_or_generate_keys()
+    handle = ClusterHandle('stpd', 'aws', 'us-east-1', None,
+                           {'instance_type': 'm6i.large'}, 1,
+                           [['10.0.0.9']], ['stpd-0'],
+                           ssh_user='skytpu', ssh_key_path=priv)
+    global_user_state.add_or_update_cluster('stpd', handle,
+                                            ClusterStatus.STOPPED)
+    with pytest.raises(exceptions.SkyTpuError, match='ABORTED'):
+        authentication.rotate_keys()
+    _, pub_after = authentication.get_or_generate_keys()
+    assert pub_after == old_pub          # keys untouched
+    assert not os.path.exists(priv + '.rotating')
