@@ -25,8 +25,8 @@ def get_hourly_cost(resources: 'resources_lib.Resources') -> float:
     """$/hr for one node of `resources` (cheapest placement if region/zone
     unset).  TPU slice prices include the host VMs."""
     cloud = resources.cloud
-    if cloud == 'local':
-        return 0.0
+    if cloud in ('local', 'slurm'):
+        return 0.0          # slurm allocations are quota'd, not billed
     if cloud == 'aws':
         from skypilot_tpu import clouds as clouds_lib
         return clouds_lib.get_cloud('aws').hourly_cost(resources)
@@ -74,6 +74,8 @@ def list_offerings(
 def get_regions(resources: 'resources_lib.Resources') -> List[str]:
     if resources.cloud == 'local':
         return ['local']
+    if resources.cloud == 'slurm':
+        return [resources.region or 'default']   # region = partition
     if resources.is_tpu:
         assert resources.tpu is not None
         regions = gcp_catalog.tpu_regions(resources.tpu.name)
@@ -88,6 +90,8 @@ def get_zones(resources: 'resources_lib.Resources',
               region: Optional[str] = None) -> List[str]:
     if resources.cloud == 'local':
         return ['local']
+    if resources.cloud == 'slurm':
+        return []                                # partitions have no zones
     if resources.is_tpu:
         assert resources.tpu is not None
         zones = gcp_catalog.tpu_zones(resources.tpu.name,

@@ -9,16 +9,18 @@ from skypilot_tpu.clouds.cloud import Cloud, CloudCapability
 from skypilot_tpu.clouds.gcp import GCP
 from skypilot_tpu.clouds.kubernetes import Kubernetes
 from skypilot_tpu.clouds.local import Local
+from skypilot_tpu.clouds.slurm import Slurm
 from skypilot_tpu.clouds.ssh import SSH
 
 __all__ = ['AWS', 'Cloud', 'CloudCapability', 'GCP', 'Kubernetes',
-           'Local', 'SSH', 'get_cloud', 'enabled_clouds',
+           'Local', 'SSH', 'Slurm', 'get_cloud', 'enabled_clouds',
            'CLOUD_REGISTRY']
 
 CLOUD_REGISTRY: Dict[str, Cloud] = {
     GCP.NAME: GCP(),
     AWS.NAME: AWS(),
     Kubernetes.NAME: Kubernetes(),
+    Slurm.NAME: Slurm(),
     Local.NAME: Local(),
     SSH.NAME: SSH(),
 }

@@ -1,0 +1,1 @@
+"""Slurm allocation provisioner (parity: sky/provision for slurm)."""

@@ -11,7 +11,7 @@ from typing import Optional
 
 from skypilot_tpu import exceptions
 
-KNOWN_CLOUDS = ('gcp', 'aws', 'local', 'kubernetes', 'ssh')
+KNOWN_CLOUDS = ('gcp', 'aws', 'slurm', 'local', 'kubernetes', 'ssh')
 WILDCARD = '*'
 
 

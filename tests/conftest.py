@@ -58,8 +58,8 @@ _E2E_MODULES = {
     'test_client_server_compat', 'test_controller_vm',
     'test_dashboard_misc', 'test_docker_runtime', 'test_execution_e2e',
     'test_fuse_proxy', 'test_managed_jobs', 'test_multiworker',
-    'test_serve', 'test_server_daemons', 'test_ssh_gang',
-    'test_transfer_logs',
+    'test_serve', 'test_server_daemons', 'test_slurm',
+    'test_ssh_gang', 'test_transfer_logs',
 }
 def pytest_addoption(parser, pluginmanager):
     """Keep bare `pytest` working without pytest-xdist: addopts carries

@@ -1,0 +1,134 @@
+"""Fake Slurm CLI shims (sbatch/squeue/scancel/scontrol) for the Slurm
+provisioner tests — sibling of the fake HTTP control planes, but as
+PATH executables since the provisioner's boundary is the CLI itself.
+
+Faithful to the real tools where the provisioner's correctness depends
+on it:
+  - squeue KEEPS terminal jobs visible (real Slurm: MinJobAge ~5 min)
+    and defaults to ALL users; `--user` filters;
+  - scontrol show job prints NodeList=(null) while PENDING and always
+    prints NumNodes.
+
+State lives in JSON at the path given to install().  Knobs:
+  pending_polls: N  -> jobs sit PENDING for N squeue polls, then RUNNING
+  behavior: 'queue_limit' -> sbatch fails like a QOSMaxSubmitJobLimit
+"""
+from __future__ import annotations
+
+import json
+import os
+import stat
+import textwrap
+
+
+def install(shim_dir, state_file, pending_polls: int = 1) -> None:
+    os.makedirs(shim_dir, exist_ok=True)
+    with open(state_file, 'w', encoding='utf-8') as f:
+        json.dump({'jobs': {}, 'next_id': 1000,
+                   'pending_polls': pending_polls, 'behavior': 'ok'},
+                  f)
+    common = textwrap.dedent(f'''\
+        #!/usr/bin/env python3
+        import getpass, json, sys
+        STATE = {state_file!r}
+        def load():
+            with open(STATE) as f:
+                return json.load(f)
+        def save(s):
+            with open(STATE, 'w') as f:
+                json.dump(s, f)
+        ''')
+    tools = {
+        'sbatch': common + textwrap.dedent('''\
+            s = load()
+            if s.get('behavior') == 'queue_limit':
+                sys.stderr.write('sbatch: error: QOSMaxSubmitJobPerUserLimit\\n')
+                sys.exit(1)
+            args = sys.argv[1:]
+            name = args[args.index('--job-name') + 1]
+            nodes = int(args[args.index('-N') + 1])
+            part = args[args.index('-p') + 1] if '-p' in args else 'default'
+            jid = str(s['next_id']); s['next_id'] += 1
+            s['jobs'][jid] = {'name': name, 'nodes': nodes,
+                              'partition': part, 'state': 'PENDING',
+                              'polls': 0, 'user': getpass.getuser()}
+            save(s)
+            print(jid)
+            '''),
+        'squeue': common + textwrap.dedent('''\
+            s = load()
+            args = sys.argv[1:]
+            want = args[args.index('--name') + 1] if '--name' in args else None
+            user = args[args.index('--user') + 1] if '--user' in args else None
+            out = []
+            for jid, j in s['jobs'].items():
+                if want and j['name'] != want:
+                    continue
+                if user and j.get('user') != user:
+                    continue
+                if j['state'] == 'PENDING':
+                    j['polls'] += 1
+                    if j['polls'] >= s['pending_polls']:
+                        j['state'] = 'RUNNING'
+                # Terminal jobs STAY VISIBLE (real squeue: MinJobAge).
+                out.append(f"{jid}|{j['state']}")
+            save(s)
+            print('\\n'.join(out))
+            '''),
+        'scancel': common + textwrap.dedent('''\
+            s = load()
+            jid = sys.argv[1]
+            if jid in s['jobs']:
+                s['jobs'][jid]['state'] = 'CANCELLED'
+            save(s)
+            '''),
+        'scontrol': common + textwrap.dedent('''\
+            s = load()
+            if sys.argv[1:3] == ['show', 'job']:
+                j = s['jobs'][sys.argv[3]]
+                if j['state'] == 'PENDING':
+                    nodelist = '(null)'       # real Slurm: no placement yet
+                elif j['nodes'] > 1:
+                    nodelist = f"fake[0-{j['nodes']-1}]"
+                else:
+                    nodelist = 'fake0'
+                print(f"JobId={sys.argv[3]} JobName={j['name']} "
+                      f"JobState={j['state']} NumNodes={j['nodes']} "
+                      f"NodeList={nodelist}")
+            elif sys.argv[1:3] == ['show', 'hostnames']:
+                spec = sys.argv[3]
+                if '[' in spec:
+                    base, rng = spec.split('[', 1)
+                    lo, hi = rng.rstrip(']').split('-')
+                    for i in range(int(lo), int(hi) + 1):
+                        print(f'{base}{i}')
+                else:
+                    print(spec)
+            '''),
+    }
+    for name, body in tools.items():
+        path = os.path.join(shim_dir, name)
+        with open(path, 'w', encoding='utf-8') as f:
+            f.write(body)
+        os.chmod(path, os.stat(path).st_mode | stat.S_IEXEC)
+
+
+def set_behavior(state_file, behavior: str) -> None:
+    with open(state_file, encoding='utf-8') as f:
+        state = json.load(f)
+    state['behavior'] = behavior
+    with open(state_file, 'w', encoding='utf-8') as f:
+        json.dump(state, f)
+
+
+def add_foreign_job(state_file, name: str, user: str) -> str:
+    """A RUNNING job owned by another user (shared login node)."""
+    with open(state_file, encoding='utf-8') as f:
+        state = json.load(f)
+    jid = str(state['next_id'])
+    state['next_id'] += 1
+    state['jobs'][jid] = {'name': name, 'nodes': 1, 'partition': 'p',
+                          'state': 'RUNNING', 'polls': 99, 'user': user}
+    with open(state_file, 'w', encoding='utf-8') as f:
+        json.dump(state, f)
+    return jid

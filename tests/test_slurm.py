@@ -1,0 +1,125 @@
+"""Slurm substrate: allocation lifecycle through the real CLI
+construction against fake sbatch/squeue/scancel/scontrol shims
+(tests/fake_slurm.py).  Ref scope: sky/clouds/slurm.py.
+"""
+import os
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import provision
+from skypilot_tpu.clouds import get_cloud
+from skypilot_tpu.provision.common import InstanceStatus, ProvisionConfig
+from skypilot_tpu.resources import Resources
+
+from tests import fake_slurm
+
+
+@pytest.fixture
+def slurm(tmp_path, monkeypatch):
+    shim = tmp_path / 'slurm-bin'
+    state = tmp_path / 'slurm-state.json'
+    fake_slurm.install(str(shim), str(state), pending_polls=2)
+    monkeypatch.setenv('PATH', f'{shim}{os.pathsep}{os.environ["PATH"]}')
+    monkeypatch.setenv('SKYTPU_PROVISION_POLL_S', '0.05')
+    return state
+
+
+def _config(cluster='hpc', nodes=2, partition='gpuq'):
+    return ProvisionConfig(
+        cluster_name=cluster, num_nodes=nodes,
+        resources_config={'infra': f'slurm/{partition}'},
+        region=partition)
+
+
+def test_allocation_lifecycle(slurm, tmp_home):
+    record = provision.run_instances('slurm', _config())
+    assert record.instance_ids == ['hpc-0', 'hpc-1']
+    # PENDING first (queued allocation), then RUNNING after the fake's
+    # poll threshold.
+    statuses = provision.query_instances('slurm', 'hpc')
+    assert all(s is InstanceStatus.PENDING for s in statuses.values())
+    provision.wait_instances('slurm', 'hpc', timeout_s=10)
+    statuses = provision.query_instances('slurm', 'hpc')
+    assert statuses == {'hpc-0': InstanceStatus.RUNNING,
+                        'hpc-1': InstanceStatus.RUNNING}
+    info = provision.get_cluster_info('slurm', 'hpc')
+    assert [i.external_ips[0] for i in info.instances] == ['fake0',
+                                                           'fake1']
+    assert info.ssh_key_path is None       # BYO identity, never ours
+    assert info.instances[0].tags['slurm_job_id']
+    # Reuse: run_instances on a live allocation resumes it.
+    record2 = provision.run_instances('slurm', _config())
+    assert record2.resumed
+    provision.terminate_instances('slurm', 'hpc')
+    assert provision.query_instances('slurm', 'hpc') == {}
+
+
+def test_stop_not_supported(slurm, tmp_home):
+    provision.run_instances('slurm', _config(cluster='ns'))
+    with pytest.raises(exceptions.NotSupportedError):
+        provision.stop_instances('slurm', 'ns')
+
+
+def test_queue_limit_classified_as_quota(slurm, tmp_home):
+    fake_slurm.set_behavior(str(slurm), 'queue_limit')
+    with pytest.raises(exceptions.QuotaExceededError):
+        provision.run_instances('slurm', _config(cluster='q'))
+
+
+def test_relaunch_after_down_submits_fresh_allocation(slurm, tmp_home):
+    """Real squeue keeps CANCELLED jobs visible for MinJobAge: a
+    relaunch right after `down` must submit a NEW sbatch, not 'resume'
+    the cancelled allocation."""
+    provision.run_instances('slurm', _config(cluster='re'))
+    provision.terminate_instances('slurm', 're')
+    record = provision.run_instances('slurm', _config(cluster='re'))
+    assert not record.resumed
+    provision.wait_instances('slurm', 're', timeout_s=10)
+
+
+def test_resume_rejects_node_count_mismatch(slurm, tmp_home):
+    provision.run_instances('slurm', _config(cluster='sz', nodes=2))
+    provision.wait_instances('slurm', 'sz', timeout_s=10)
+    with pytest.raises(exceptions.ProvisionError, match='cannot resize'):
+        provision.run_instances('slurm', _config(cluster='sz', nodes=4))
+
+
+def test_other_users_jobs_invisible(slurm, tmp_home):
+    """Shared login node: another user's identically-named job is never
+    ours to resume or cancel."""
+    fake_slurm.add_foreign_job(str(slurm), 'skytpu-shared', 'someoneelse')
+    assert provision.query_instances('slurm', 'shared') == {}
+    record = provision.run_instances('slurm', _config(cluster='shared',
+                                                      nodes=1))
+    assert not record.resumed               # fresh sbatch, not theirs
+
+
+def test_pending_allocation_reports_all_nodes(slurm, tmp_home):
+    """While PENDING, NodeList is (null); the node count must come from
+    NumNodes so both nodes show as pending."""
+    provision.run_instances('slurm', _config(cluster='pp', nodes=2))
+    statuses = provision.query_instances('slurm', 'pp')
+    assert statuses == {'pp-0': InstanceStatus.PENDING,
+                        'pp-1': InstanceStatus.PENDING}
+
+
+def test_cloud_feasibility_and_gates(slurm):
+    cloud = get_cloud('slurm')
+    assert cloud.check_credentials() == (True, None)   # shims on PATH
+    res = Resources.from_yaml_config({'infra': 'slurm/gpuq'})
+    cands = cloud.get_feasible_resources(res)
+    assert len(cands) == 1 and cands[0].region == 'gpuq'
+    assert cloud.hourly_cost(cands[0]) == 0.0
+    from skypilot_tpu.clouds import CloudCapability
+    assert not cloud.supports(CloudCapability.STOP)
+    assert not cloud.supports(CloudCapability.SPOT)
+    assert cloud.supports(CloudCapability.MULTI_NODE)
+    # TPU requests never route to slurm...
+    tpu_res = Resources.from_yaml_config({'accelerators': 'tpu-v5e-8',
+                                          'infra': 'slurm'})
+    assert cloud.get_feasible_resources(tpu_res) == []
+    # ...and neither do UNPINNED requests ($0/hr would otherwise win
+    # every cost optimization — explicit `infra: slurm` only).
+    unpinned = Resources.from_yaml_config({'cpus': '2'})
+    assert cloud.get_feasible_resources(unpinned) == []
