@@ -120,7 +120,8 @@ class StorageMount:
         store_type = StoreType(self.store) if self.store else StoreType.GCS
         Storage(self.name, source=local_source,
                 store=store_type).materialize()
-        scheme = 's3' if store_type is StoreType.S3 else 'gs'
+        scheme = {StoreType.S3: 's3', StoreType.R2: 'r2'}.get(
+            store_type, 'gs')
         return f'{scheme}://{self.name}'
 
 
@@ -318,11 +319,20 @@ class S3Store(_BucketStore):
         return subprocess.run(['aws', 's3', *args], check=False,
                               capture_output=True, text=True)
 
+    @property
+    def _cli_url(self) -> str:
+        """The URL handed to the aws CLI (always s3://; R2 keeps its own
+        r2:// in `url` for display/scheme routing)."""
+        return f's3://{self.bucket}'
+
+    def _cli_prefix(self, prefix: str) -> str:
+        return f'{self._cli_url}/{prefix}'.rstrip('/')
+
     def _real_exists(self) -> bool:
-        return self._aws('ls', self.url).returncode == 0
+        return self._aws('ls', self._cli_url).returncode == 0
 
     def _real_create(self, region: Optional[str]) -> None:
-        args = ['mb', self.url]
+        args = ['mb', self._cli_url]
         if region:
             args += ['--region', region]
         res = self._aws(*args)
@@ -332,7 +342,7 @@ class S3Store(_BucketStore):
                 f'failed to create {self.url}: {res.stderr.strip()}')
 
     def _real_delete(self) -> None:
-        res = self._aws('rb', self.url, '--force')
+        res = self._aws('rb', self._cli_url, '--force')
         if res.returncode != 0 and 'nosuchbucket' not in \
                 res.stderr.lower().replace(' ', ''):
             raise exceptions.StorageError(
@@ -340,7 +350,7 @@ class S3Store(_BucketStore):
 
     def _real_sync_up(self, src_dir: str, prefix: str,
                       excludes: List[str]) -> None:
-        args = ['sync', src_dir, self._url_prefix(prefix)]
+        args = ['sync', src_dir, self._cli_prefix(prefix)]
         for pat in excludes:                 # aws s3 takes globs directly
             args += ['--exclude', pat]
         res = self._aws(*args)
@@ -349,13 +359,13 @@ class S3Store(_BucketStore):
                 f'sync_up to {self.url} failed: {res.stderr.strip()}')
 
     def _real_sync_down(self, local_dir: str, prefix: str) -> None:
-        res = self._aws('sync', self._url_prefix(prefix), local_dir)
+        res = self._aws('sync', self._cli_prefix(prefix), local_dir)
         if res.returncode != 0:
             raise exceptions.StorageError(
                 f'sync_down from {self.url} failed: {res.stderr.strip()}')
 
     def _real_list_prefix(self, prefix: str) -> List[str]:
-        res = self._aws('ls', '--recursive', self._url_prefix(prefix))
+        res = self._aws('ls', '--recursive', self._cli_prefix(prefix))
         if res.returncode != 0:
             return []
         return sorted(line.split(None, 3)[3]
@@ -363,14 +373,49 @@ class S3Store(_BucketStore):
                       if len(line.split(None, 3)) == 4)
 
 
+class R2Store(S3Store):
+    """Cloudflare R2 (parity: sky/data/storage.py R2Store :4561).
+
+    R2 speaks the S3 API behind an account endpoint: everything is the
+    S3Store with ``--endpoint-url`` appended and s3:// CLI URIs (the
+    aws CLI rejects r2://); config ``r2.endpoint_url`` or
+    SKYTPU_R2_ENDPOINT_URL; credentials ride the standard AWS
+    env/profile.  SKYTPU_FAKE_S3_ROOT covers R2 in tests the same way
+    it covers S3 (one S3-compatible fake boundary).
+    """
+
+    SCHEME = 'r2'
+
+    @staticmethod
+    def _endpoint() -> Optional[str]:
+        url = os.environ.get('SKYTPU_R2_ENDPOINT_URL')
+        if url:
+            return url
+        from skypilot_tpu import sky_config
+        return sky_config.get_nested(('r2', 'endpoint_url'), None)
+
+    def _aws(self, *args: str) -> subprocess.CompletedProcess:
+        endpoint = self._endpoint()
+        if not endpoint:
+            raise exceptions.StorageError(
+                'R2 needs an account endpoint: set r2.endpoint_url in '
+                'config (or SKYTPU_R2_ENDPOINT_URL), e.g. '
+                'https://<account_id>.r2.cloudflarestorage.com')
+        return subprocess.run(
+            ['aws', 's3', '--endpoint-url', endpoint, *args],
+            check=False, capture_output=True, text=True)
+
+
 def store_for_url(url: str):
-    """gs://b -> GcsStore('b'), s3://b -> S3Store('b')."""
+    """gs://b -> GcsStore, s3://b -> S3Store, r2://b -> R2Store."""
     store_type = StoreType.from_url(url)
     bucket = url.split('://', 1)[1].split('/', 1)[0]
     if store_type is StoreType.GCS:
         return GcsStore(bucket)
     if store_type is StoreType.S3:
         return S3Store(bucket)
+    if store_type is StoreType.R2:
+        return R2Store(bucket)
     raise exceptions.StorageError(f'No store backend for {url}')
 
 
@@ -385,6 +430,7 @@ class Storage:
 
     def materialize(self):
         store = (S3Store(self.name) if self.store is StoreType.S3
+                 else R2Store(self.name) if self.store is StoreType.R2
                  else GcsStore(self.name))
         if not store.exists():
             store.create()
@@ -405,14 +451,23 @@ def copy_command(source: str, dst: str) -> str:
                     f'cp -a {q(src)}/. {q(dst)}/')
         return (f'mkdir -p {q(dst)} && '
                 f'gsutil -m rsync -r {q(source)} {q(dst)}')
-    if store is StoreType.S3:
+    if store in (StoreType.S3, StoreType.R2):
         root = _fake_s3_root()
         if root is not None:
-            src = os.path.join(root, source[len('s3://'):])
+            src = os.path.join(root, source.split('://', 1)[1])
             return (f'mkdir -p {q(dst)} && mkdir -p {q(src)} && '
                     f'cp -a {q(src)}/. {q(dst)}/')
+        endpoint = ''
+        s3_url = source
+        if store is StoreType.R2:
+            ep = R2Store._endpoint()  # pylint: disable=protected-access
+            if not ep:
+                raise exceptions.StorageError(
+                    'R2 COPY needs r2.endpoint_url configured')
+            endpoint = f'--endpoint-url {q(ep)} '
+            s3_url = 's3://' + source[len('r2://'):]
         return (f'mkdir -p {q(dst)} && '
-                f'aws s3 sync {q(source)} {q(dst)}')
+                f'aws s3 {endpoint}sync {q(s3_url)} {q(dst)}')
     raise exceptions.StorageError(f'COPY unsupported for {store}')
 
 
@@ -441,8 +496,8 @@ def mount_command(source: str, mount_path: str,
         return (f'mkdir -p {q(mount_path)} && '
                 f'(mountpoint -q {q(mount_path)} || '
                 f'gcsfuse {flags} {q(bucket)} {q(mount_path)})')
-    if store is StoreType.S3:
-        bucket_and_prefix = source[len('s3://'):]
+    if store in (StoreType.S3, StoreType.R2):
+        bucket_and_prefix = source.split('://', 1)[1]
         root = _fake_s3_root()
         if root is not None:
             target = os.path.join(root, bucket_and_prefix)
@@ -450,18 +505,27 @@ def mount_command(source: str, mount_path: str,
                     f'mkdir -p "$(dirname {q(mount_path)})" && '
                     f'ln -sfn {q(target)} {q(mount_path)}')
         bucket = bucket_and_prefix.split('/', 1)[0]
+        endpoint_flag = ''
+        if store is StoreType.R2:
+            ep = R2Store._endpoint()  # pylint: disable=protected-access
+            if not ep:
+                raise exceptions.StorageError(
+                    'R2 MOUNT needs r2.endpoint_url configured')
+            endpoint_flag = f'--endpoint {q(ep)} '
         if cached:
             # rclone VFS write-back cache (ref mounting_utils rclone
             # path): survives re-reads without re-fetching.
+            rclone_ep = (f'--s3-endpoint {q(ep)} '
+                         if store is StoreType.R2 else '')
             return (f'mkdir -p {q(mount_path)} && '
                     f'(mountpoint -q {q(mount_path)} || '
                     f'rclone mount --daemon --vfs-cache-mode writes '
-                    f':s3:{q(bucket)} {q(mount_path)})')
+                    f'{rclone_ep}:s3:{q(bucket)} {q(mount_path)})')
         return (f'mkdir -p {q(mount_path)} && '
                 f'(mountpoint -q {q(mount_path)} || '
-                f'goofys {q(bucket)} {q(mount_path)})')
+                f'goofys {endpoint_flag}{q(bucket)} {q(mount_path)})')
     raise exceptions.StorageError(
-        f'MOUNT supports gs:// and s3://, got {source}')
+        f'MOUNT supports gs://, s3:// and r2://, got {source}')
 
 
 def fetch_bucket_to_cluster(backend: 'tpu_vm_backend.TpuVmBackend',

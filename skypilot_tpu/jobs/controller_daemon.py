@@ -49,14 +49,21 @@ def main() -> int:
         f.write(str(os.getpid()))
     from skypilot_tpu import sky_logging
     from skypilot_tpu.jobs import controller as controller_lib
+    from skypilot_tpu.serve import controller as serve_controller_lib
     logger = sky_logging.init_logger(__name__)
-    logger.info('jobs controller daemon up (pid %d)', os.getpid())
+    logger.info('controller daemon up (pid %d)', os.getpid())
     poll = float(os.environ.get('SKYTPU_JOBS_POLL_INTERVAL', '10'))
     while True:
+        # Both controller kinds: a host dedicated to one namespace just
+        # finds the other's state DB empty.
         try:
             controller_lib.maybe_start_controllers()
         except Exception as e:  # pylint: disable=broad-except
-            logger.error('controller tick failed: %s', e)
+            logger.error('jobs controller tick failed: %s', e)
+        try:
+            serve_controller_lib.maybe_start_controllers()
+        except Exception as e:  # pylint: disable=broad-except
+            logger.error('serve controller tick failed: %s', e)
         time.sleep(max(poll, 0.2))
 
 

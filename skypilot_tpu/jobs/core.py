@@ -15,10 +15,8 @@ consolidation mode keeps them in the API server):
 from __future__ import annotations
 
 import base64
-import io
 import json
 import os
-import shlex
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu import exceptions
@@ -29,67 +27,23 @@ from skypilot_tpu.jobs import state
 from skypilot_tpu.jobs.recovery_strategy import (StrategyName,
                                                  task_recovery_config)
 
-JOBS_CONTROLLER_CLUSTER = 'skytpu-jobs-controller'
+from skypilot_tpu.controller_vm import (  # noqa: E402  (shared machinery)
+    JOBS_CONTROLLER_CLUSTER)
 
 
 def _controller_mode() -> str:
-    # remote_exec sets the override ON the controller host so the verbs
-    # it runs operate locally instead of recursing remotely.
-    if os.environ.get('SKYTPU_JOBS_LOCAL_MODE') == '1':
-        return 'consolidation'
-    from skypilot_tpu import sky_config
-    return str(sky_config.get_nested(('jobs', 'controller', 'mode'),
-                                     'consolidation'))
+    from skypilot_tpu import controller_vm
+    return controller_vm.mode('jobs')
 
 
 def _ensure_controller_cluster() -> None:
-    from skypilot_tpu import execution
-    from skypilot_tpu import resources as resources_lib
-    from skypilot_tpu import sky_config
-    from skypilot_tpu.global_user_state import ClusterStatus
-    record = global_user_state.get_cluster(JOBS_CONTROLLER_CLUSTER)
-    if record is not None and record['status'] is ClusterStatus.UP:
-        return
-    res_cfg = sky_config.get_nested(('jobs', 'controller', 'resources'),
-                                    {'cpus': '4+'})
-    t = task_lib.Task('jobs-controller', run=None)
-    t.set_resources(resources_lib.Resources.from_yaml_config(
-        dict(res_cfg)))
-    execution.launch(t, JOBS_CONTROLLER_CLUSTER, quiet_optimizer=True,
-                     policy_operation='jobs controller launch')
+    from skypilot_tpu import controller_vm
+    controller_vm.ensure_cluster(JOBS_CONTROLLER_CLUSTER, 'jobs')
 
 
 def _remote_call(args: List[str]) -> Dict[str, Any]:
-    """Run one remote_exec verb on the controller cluster; parse the
-    sentinel JSON line back out of the job logs.
-
-    The acting user + workspace ride along as env so the verb executes
-    AS this caller on the controller host — its consolidation-path code
-    then runs the same RBAC/workspace guards it runs locally (without
-    this, any vm-mode caller could cancel anyone's job)."""
-    from skypilot_tpu import execution
-    from skypilot_tpu import users as users_lib
-    from skypilot_tpu import workspaces as workspaces_lib
-    from skypilot_tpu.backends import TpuVmBackend
-    from skypilot_tpu.jobs import remote_exec
-    cmd = ('PYTHONPATH="$HOME/skytpu_runtime:$PYTHONPATH" '
-           'SKYTPU_JOBS_LOCAL_MODE=1 '
-           f'SKYTPU_USER={shlex.quote(users_lib.current_user().name)} '
-           f'SKYTPU_WORKSPACE='
-           f'{shlex.quote(workspaces_lib.active_workspace())} '
-           f'python -m skypilot_tpu.jobs.remote_exec '
-           f'{shlex.join(args)}')
-    t = task_lib.Task('jobs-verb', run=cmd)
-    job_id, handle = execution.exec_(t, JOBS_CONTROLLER_CLUSTER)
-    backend = TpuVmBackend()
-    buf = io.StringIO()
-    rc = backend.tail_logs(handle, job_id, follow=True, out=buf)
-    for line in buf.getvalue().splitlines():
-        if line.startswith(remote_exec.SENTINEL):
-            return json.loads(line[len(remote_exec.SENTINEL):])
-    raise exceptions.ManagedJobStatusError(
-        f'controller verb {args[0]!r} produced no result '
-        f'(rc={rc}): {buf.getvalue()[-500:]}')
+    from skypilot_tpu import controller_vm
+    return controller_vm.remote_call(JOBS_CONTROLLER_CLUSTER, args)
 
 
 def _recovery_config(task: task_lib.Task) -> Dict[str, Any]:

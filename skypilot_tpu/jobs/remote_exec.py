@@ -15,7 +15,13 @@ Usage (on the controller host):
   python -m skypilot_tpu.jobs.remote_exec launch <base64(json)>
   python -m skypilot_tpu.jobs.remote_exec queue
   python -m skypilot_tpu.jobs.remote_exec cancel <job_id>
-  python -m skypilot_tpu.jobs.remote_exec logs <job_id>
+  python -m skypilot_tpu.jobs.remote_exec logs <job_id> [offset]
+  python -m skypilot_tpu.jobs.remote_exec serve_up <base64(json)>
+  python -m skypilot_tpu.jobs.remote_exec serve_update <base64(json)>
+  python -m skypilot_tpu.jobs.remote_exec serve_down <name> [purge]
+  python -m skypilot_tpu.jobs.remote_exec serve_status [name]
+(serve verbs live here too: both controller kinds share the transport
+and, when co-hosted, the daemon.)
 """
 from __future__ import annotations
 
@@ -128,6 +134,35 @@ def main(argv) -> int:
             offset += len(raw)
         _emit({'logs': text, 'offset': offset,
                'status': rec['status'].value})
+    elif verb == 'serve_up':
+        from skypilot_tpu.serve import core as serve_core
+        spec = json.loads(base64.b64decode(argv[1]))
+        task = task_lib.Task.from_yaml_config(spec['task'])
+        result = serve_core.up(task, service_name=spec.get('name'),
+                               lb_port=spec.get('lb_port'))
+        _emit({'name': result['name'],
+               'port': int(result['endpoint'].rsplit(':', 1)[1])})
+    elif verb == 'serve_update':
+        from skypilot_tpu.serve import core as serve_core
+        spec = json.loads(base64.b64decode(argv[1]))
+        task = task_lib.Task.from_yaml_config(spec['task'])
+        result = serve_core.update(task, service_name=spec.get('name'))
+        _emit({'name': spec.get('name'), 'version': result['version']})
+    elif verb == 'serve_down':
+        from skypilot_tpu.serve import core as serve_core
+        serve_core.down(argv[1], purge=len(argv) > 2 and argv[2] == '1')
+        _emit({'down': argv[1]})
+    elif verb == 'serve_status':
+        from skypilot_tpu.serve import core as serve_core
+        names = [argv[1]] if len(argv) > 1 else None
+        records = []
+        for rec in serve_core.status(names):
+            rec = dict(rec)
+            rec['status'] = rec['status'].value
+            rec['replicas'] = [dict(r, status=r['status'].value)
+                               for r in rec['replicas']]
+            records.append(rec)
+        _emit({'services': records})
     else:
         _emit({'error': f'unknown verb {verb}'})
         return 2

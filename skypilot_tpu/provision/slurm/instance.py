@@ -69,36 +69,45 @@ def _job_name(cluster_name: str) -> str:
     return f'{_JOB_PREFIX}{cluster_name}'
 
 
+_DEAD_STATES = frozenset(
+    s for s, mapped in _STATE_MAP.items()
+    if mapped in (common.InstanceStatus.TERMINATED,
+                  common.InstanceStatus.PREEMPTED))
 _TERMINAL_STATES = frozenset(
     s for s, mapped in _STATE_MAP.items()
     if mapped is common.InstanceStatus.TERMINATED)
 
 
-def _find_job(cluster_name: str) -> Optional[Dict[str, str]]:
-    """{'id':…, 'state':…} of the newest non-terminal allocation job.
+def _find_job(cluster_name: str,
+              live_only: bool = False) -> Optional[Dict[str, str]]:
+    """{'id':…, 'state':…} of the newest matching allocation job.
 
     Scoped to THE CURRENT USER (shared login nodes: another user's
-    identically-named job must never be mistaken for ours) and filtered
-    of terminal states client-side (real squeue keeps finished jobs
-    visible for MinJobAge, ~5 min by default)."""
+    identically-named job must never be mistaken for ours).  Terminal
+    states are always filtered client-side (real squeue keeps finished
+    jobs visible for MinJobAge, ~5 min).  live_only additionally drops
+    PREEMPTED/NODE_FAIL jobs — a provisioning call must submit a FRESH
+    sbatch for those, while status reconciliation (live_only=False)
+    must still SEE them to report the preemption."""
     import getpass
     out = _run(['squeue', '--name', _job_name(cluster_name),
                 '--user', getpass.getuser(), '--noheader',
                 '-o', '%i|%T'])
+    drop = _DEAD_STATES if live_only else _TERMINAL_STATES
     jobs = []
     for line in out.splitlines():
         line = line.strip()
         if not line:
             continue
         job_id, state = line.split('|', 1)
-        if state.strip() in _TERMINAL_STATES:
+        if state.strip() in drop:
             continue
         jobs.append({'id': job_id.strip(), 'state': state.strip()})
     return jobs[-1] if jobs else None
 
 
 def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
-    existing = _find_job(config.cluster_name)
+    existing = _find_job(config.cluster_name, live_only=True)
     if existing is not None:
         # Reuse only a size-compatible allocation: Slurm cannot grow a
         # running job, so silently "resuming" a smaller allocation would
@@ -140,11 +149,11 @@ def wait_instances(cluster_name: str, region=None, zone=None,
     del region, zone
     deadline = time.time() + timeout_s
     while time.time() < deadline:
-        job = _find_job(cluster_name)
+        job = _find_job(cluster_name, live_only=True)
         if job is None:
             raise exceptions.ProvisionError(
                 f'slurm allocation for {cluster_name!r} disappeared '
-                f'while waiting')
+                f'while waiting (cancelled or preempted)')
         status = _STATE_MAP.get(job['state'],
                                 common.InstanceStatus.PENDING)
         if status is common.InstanceStatus.RUNNING:
@@ -159,34 +168,38 @@ def wait_instances(cluster_name: str, region=None, zone=None,
         f'timed out waiting for slurm allocation of {cluster_name!r}')
 
 
-def _nodes(job_id: str) -> List[str]:
-    """Hostnames of a RUNNING allocation ([] while PENDING — real Slurm
-    reports NodeList=(null) until placement)."""
+def _job_details(job_id: str) -> 'tuple[List[str], Optional[int]]':
+    """(hostnames, requested_node_count) from ONE scontrol invocation.
+
+    Hostnames are [] while PENDING (real Slurm reports NodeList=(null)
+    until placement); NumNodes is present either way."""
     out = _run(['scontrol', 'show', 'job', job_id])
     nodelist = None
+    num_nodes: Optional[int] = None
     for token in out.replace('\n', ' ').split():
         if token.startswith('NodeList=') and not token.startswith(
                 'NodeList=(null)'):
             nodelist = token.split('=', 1)[1]
-    if not nodelist:
-        return []
-    hosts = _run(['scontrol', 'show', 'hostnames', nodelist])
-    return [h.strip() for h in hosts.splitlines() if h.strip()]
-
-
-def _requested_nodes(job_id: str) -> Optional[int]:
-    """The allocation's node count (NumNodes — present even PENDING,
-    when NodeList is still (null))."""
-    out = _run(['scontrol', 'show', 'job', job_id])
-    for token in out.replace('\n', ' ').split():
-        if token.startswith('NumNodes='):
+        elif token.startswith('NumNodes='):
             # Real scontrol can print a range ('2-2'); take the floor.
             value = token.split('=', 1)[1].split('-')[0]
             try:
-                return int(value)
+                num_nodes = int(value)
             except ValueError:
-                return None
-    return None
+                pass
+    hosts: List[str] = []
+    if nodelist:
+        raw = _run(['scontrol', 'show', 'hostnames', nodelist])
+        hosts = [h.strip() for h in raw.splitlines() if h.strip()]
+    return hosts, num_nodes
+
+
+def _nodes(job_id: str) -> List[str]:
+    return _job_details(job_id)[0]
+
+
+def _requested_nodes(job_id: str) -> Optional[int]:
+    return _job_details(job_id)[1]
 
 
 def query_instances(cluster_name: str, region=None,
@@ -200,7 +213,8 @@ def query_instances(cluster_name: str, region=None,
         return {}
     # A PENDING allocation has no NodeList yet; size from NumNodes so a
     # queued 2-node cluster reports BOTH nodes pending, not one.
-    n = len(_nodes(job['id'])) or _requested_nodes(job['id']) or 1
+    hosts, requested = _job_details(job['id'])
+    n = len(hosts) or requested or 1
     return {f'{cluster_name}-{i}': status for i in range(n)}
 
 

@@ -179,6 +179,15 @@ _controllers: Dict[str, threading.Thread] = {}
 _shutdown = threading.Event()
 
 
+def live_controllers() -> list:
+    """Service names with a live controller thread IN THIS PROCESS
+    (dedicated mode keeps this empty in the API server — the daemon on
+    the serve controller cluster owns them)."""
+    with _manager_lock:
+        return [name for name, th in _controllers.items()
+                if th.is_alive()]
+
+
 def stop_all_controllers(timeout_s: float = 15.0) -> None:
     """Cooperatively stop every service controller without status
     writes (services stay re-adoptable); mirrors

@@ -251,3 +251,31 @@ def test_aws_credential_check_modes(monkeypatch):
     monkeypatch.setenv('AWS_ACCESS_KEY_ID', 'AKIATEST')
     monkeypatch.setenv('AWS_SECRET_ACCESS_KEY', 'secret')
     assert cloud.check_credentials() == (True, None)
+
+
+# ----- R2 (S3-compatible behind an account endpoint) -------------------------
+def test_r2_store_rides_s3_fake(fake_s3, tmp_path):
+    store = storage_lib.R2Store('r2bkt')
+    store.create()
+    src = tmp_path / 'src'
+    src.mkdir()
+    (src / 'x.txt').write_text('X')
+    store.sync_up(str(src))
+    assert store.list_prefix() == ['x.txt']
+    assert isinstance(storage_lib.store_for_url('r2://b'),
+                      storage_lib.R2Store)
+
+
+def test_r2_real_commands_need_endpoint(monkeypatch):
+    monkeypatch.delenv('SKYTPU_FAKE_S3_ROOT', raising=False)
+    monkeypatch.delenv('SKYTPU_R2_ENDPOINT_URL', raising=False)
+    with pytest.raises(exceptions.StorageError, match='endpoint_url'):
+        storage_lib.copy_command('r2://bkt/ckpt', '/dst')
+    monkeypatch.setenv('SKYTPU_R2_ENDPOINT_URL',
+                       'https://acct.r2.cloudflarestorage.com')
+    cmd = storage_lib.copy_command('r2://bkt/ckpt', '/dst')
+    assert '--endpoint-url' in cmd and 's3://bkt/ckpt' in cmd
+    mnt = storage_lib.mount_command('r2://bkt', '/mnt/r2')
+    assert 'goofys' in mnt and '--endpoint' in mnt
+    cached = storage_lib.mount_command('r2://bkt', '/mnt/r2', cached=True)
+    assert 'rclone mount' in cached and '--s3-endpoint' in cached

@@ -107,3 +107,91 @@ def test_vm_mode_cancel(vm_mode):
     _wait(job_id, (ManagedJobStatus.RUNNING,))
     assert jobs_core.cancel(job_id)
     _wait(job_id, (ManagedJobStatus.CANCELLED,))
+
+
+# ----- serve on a dedicated controller ---------------------------------------
+@pytest.fixture
+def serve_vm_mode(tmp_home, enable_all_clouds, monkeypatch):
+    monkeypatch.setenv('SKYTPU_JOBS_POLL_INTERVAL', '0.25')
+    monkeypatch.setenv('SKYTPU_SERVE_TICK_INTERVAL', '0.25')
+    config = tmp_home / '.skytpu' / 'config.yaml'
+    config.parent.mkdir(parents=True, exist_ok=True)
+    config.write_text(
+        'serve:\n'
+        '  controller:\n'
+        '    mode: vm\n'
+        '    resources:\n'
+        '      infra: local\n')
+    from skypilot_tpu import sky_config
+    sky_config.reset_cache_for_tests()
+    yield tmp_home
+    try:
+        pid = int(open(controller_daemon.pid_file_path(),
+                       encoding='utf-8').read())
+        os.kill(pid, signal.SIGKILL)
+    except (OSError, ValueError):
+        pass
+    sky_config.reset_cache_for_tests()
+    from skypilot_tpu.serve import controller as serve_ctl
+    serve_ctl.stop_all_controllers()
+    controller_lib.stop_all_controllers()
+
+
+_REPLICA_RUN = (
+    "python3 -c \"import http.server, os\n"
+    "class H(http.server.BaseHTTPRequestHandler):\n"
+    "    def do_GET(self):\n"
+    "        self.send_response(200)\n"
+    "        self.send_header('Content-Length', '2')\n"
+    "        self.end_headers(); self.wfile.write(b'ok')\n"
+    "    def log_message(self, *a): pass\n"
+    "http.server.HTTPServer(('127.0.0.1', "
+    "int(os.environ['SKYTPU_SERVE_REPLICA_PORT'])), H).serve_forever()\"")
+
+
+@pytest.mark.e2e
+def test_serve_vm_mode_end_to_end(serve_vm_mode):
+    """Service controller + LB live on the dedicated controller cluster;
+    this process runs NO serve controllers, yet the service comes up,
+    answers through the controller-host endpoint, and tears down."""
+    import urllib.request
+    from skypilot_tpu import serve as serve_lib
+    from skypilot_tpu.serve import controller as serve_ctl
+    from skypilot_tpu.serve.serve_state import ServiceStatus
+    from skypilot_tpu.task import Task
+    from skypilot_tpu.resources import Resources
+
+    t = Task('vmsvc', run=_REPLICA_RUN, service={
+        'readiness_probe': {'path': '/', 'initial_delay_seconds': 30},
+        'replicas': 1,
+    })
+    t.set_resources(Resources.from_yaml_config({'infra': 'local'}))
+    result = serve_lib.up(t)
+    assert 'endpoint' in result
+
+    # No serve controller threads in THIS process — the daemon on the
+    # controller cluster drives the service.
+    assert not serve_ctl.live_controllers()
+
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        records = serve_lib.status('vmsvc')
+        if records and records[0]['status'] is ServiceStatus.READY:
+            break
+        time.sleep(0.5)
+    else:
+        raise TimeoutError(f'never READY: {records}')
+    assert controller_daemon.daemon_alive()
+
+    body = urllib.request.urlopen(result['endpoint'], timeout=10).read()
+    assert body == b'ok'
+
+    serve_lib.down('vmsvc')
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        records = serve_lib.status('vmsvc')
+        if not records or records[0]['status'] is ServiceStatus.SHUTDOWN:
+            break
+        time.sleep(0.5)
+    else:
+        raise TimeoutError(f'service never torn down: {records}')
