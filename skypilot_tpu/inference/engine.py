@@ -343,6 +343,34 @@ class DecodeEngine:
         """Synchronous helper: submit and wait."""
         return self.submit(prompt_ids, max_new_tokens).tokens()
 
+    def drain(self) -> None:
+        """Run the pipelined loop until FULLY idle: queue empty, no
+        active slots, nothing in flight (the last retire typically
+        leaves one garbage call in flight — see step_pipelined)."""
+        while (self._inflight is not None or
+               not self._prefill_q.empty() or
+               any(s is not None for s in self._slots)):
+            self.step_pipelined()
+
+    def update_params(self, params) -> None:
+        """Swap the served weights in place (RL loops, rolling weight
+        refresh): keeps every compiled program and the TPU layout
+        optimization — the new tree is laid out into the formats the
+        decode executable was pinned to.  The engine must be idle (no
+        active slots, queue drained, nothing in flight): a mid-decode
+        swap would mix policies within one request."""
+        with self._submit_lock:
+            if (self._inflight is not None or
+                    not self._prefill_q.empty() or
+                    any(s is not None for s in self._slots)):
+                raise RuntimeError(
+                    'update_params requires an idle engine (drain '
+                    'requests first)')
+            if self._fmt_params is not None:
+                import jax as _jax
+                params = _jax.device_put(params, self._fmt_params)
+            self.params = params
+
     def prewarm(self) -> None:
         """Compile every prefill shape up front (TPU layout path only).
 

@@ -1,0 +1,122 @@
+"""RL fine-tuning primitives: policy-gradient updates over sampled
+continuations (the first-party twin of the reference's RL recipe
+integration, llm/verl/ — which delegates the math to an external
+framework; here the loop is native so it runs the same engine + trainer
+stack as everything else, SURVEY.md §2.15).
+
+The pattern, TPU-first:
+- ROLLOUT on the serving engine (inference/engine.py): sampling runs in
+  the continuous-batching decode loop at serving efficiency — the
+  actor's forward pass is the same bandwidth-optimal program that
+  serves traffic (temperature > 0 for exploration);
+- UPDATE with one jitted program: a REINFORCE/GRPO-style masked
+  log-prob loss whose forward is a standard teacher-forced pass over
+  [prompt + sampled] — one big MXU matmul batch, no per-token Python;
+- advantages are plain host arrays (reward whitening happens host-side
+  where reward functions live).
+
+This is deliberately the PRIMITIVE layer: PPO ratios/KL penalties
+compose on top by passing `ref_logprobs`; the example recipe
+(examples/train_rl_reinforce.yaml) shows the whole loop.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def sequence_logprobs(logits: jax.Array, tokens: jax.Array) -> jax.Array:
+    """Per-position log p(tokens[t] | tokens[<t]) from next-token
+    logits: logits[:, t] predicts tokens[:, t+1].  Returns [B, S-1]."""
+    logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+    return jnp.take_along_axis(logp, tokens[:, 1:, None],
+                               axis=-1)[..., 0]
+
+
+def reinforce_loss(logits: jax.Array, tokens: jax.Array,
+                   advantages: jax.Array, prompt_lens: jax.Array,
+                   total_lens: jax.Array,
+                   ref_logprobs: Optional[jax.Array] = None,
+                   kl_coef: float = 0.0) -> jax.Array:
+    """REINFORCE objective over each row's SAMPLED region only.
+
+    tokens [B, S] = prompt + sampled continuation, zero-padded to S
+    (teacher-forced); advantages [B] (whitened rewards); prompt_lens
+    and total_lens are PER-ROW [B] — rows may have different prompt
+    and continuation lengths, and padding beyond total_lens must never
+    reach the gradient (it would push probability mass onto the pad
+    token for positively-advantaged rows).  Optional KL regularization
+    toward a reference policy's per-token logprobs (PPO-lite: keeps the
+    policy near the base model).
+    """
+    logprobs = sequence_logprobs(logits, tokens)          # [B, S-1]
+    positions = jnp.arange(tokens.shape[1] - 1)[None, :]
+    mask = ((positions >= prompt_lens[:, None] - 1) &
+            (positions < total_lens[:, None] - 1)).astype(logprobs.dtype)
+    pg = -(advantages[:, None] * logprobs * mask).sum() / \
+        jnp.maximum(mask.sum(), 1.0)
+    if ref_logprobs is not None and kl_coef > 0.0:
+        kl = ((logprobs - ref_logprobs) * mask).sum() / \
+            jnp.maximum(mask.sum(), 1.0)
+        pg = pg + kl_coef * kl
+    return pg
+
+
+def whiten(rewards: np.ndarray) -> np.ndarray:
+    """Standard advantage whitening (mean 0, std 1; std floor for the
+    all-equal case)."""
+    rewards = np.asarray(rewards, np.float32)
+    return (rewards - rewards.mean()) / max(float(rewards.std()), 1e-6)
+
+
+def make_reinforce_step(model, tx, kl_coef: float = 0.0):
+    """Jitted (params, opt_state, tokens, advantages, prompt_lens,
+    total_lens[, ref_logprobs]) -> (params, opt_state, loss).  One
+    compiled program per (B, S) shape — pad rollout batches to fixed
+    shapes the usual way (lengths are traced values, not shapes)."""
+    import optax
+
+    def step(params, opt_state, tokens, advantages, prompt_lens,
+             total_lens, ref_logprobs=None):
+        def loss_fn(p):
+            logits = model.apply({'params': p}, tokens)
+            return reinforce_loss(logits, tokens, advantages,
+                                  prompt_lens, total_lens,
+                                  ref_logprobs, kl_coef)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(step)
+
+
+def rollout(engine, prompts: List[List[int]], max_new_tokens: int,
+            reward_fn: Callable[[List[int], List[int]], float]
+            ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Sample continuations on the decode engine and score them.
+
+    Returns (tokens [B, S] zero-padded, advantages [B],
+    prompt_lens [B], total_lens [B]) — per-row lengths feed
+    reinforce_loss's mask so padding and unequal prompts never reach
+    the gradient.  The engine must be constructed with temperature > 0
+    (greedy rollouts collapse the policy gradient to a point mass).
+    """
+    reqs = [engine.submit(p, max_new_tokens) for p in prompts]
+    while any(r.finished_at is None for r in reqs):
+        engine.step_pipelined()
+    engine.drain()          # retire-lag garbage call; engine now idle
+    sampled = [r.tokens() for r in reqs]
+    rewards = [reward_fn(p, s) for p, s in zip(prompts, sampled)]
+    prompt_lens = np.asarray([len(p) for p in prompts], np.int32)
+    total_lens = np.asarray(
+        [len(p) + len(s) for p, s in zip(prompts, sampled)], np.int32)
+    tokens = np.zeros((len(prompts), int(total_lens.max())), np.int32)
+    for i, (p, s) in enumerate(zip(prompts, sampled)):
+        seq = list(p) + list(s)
+        tokens[i, :len(seq)] = seq
+    return tokens, whiten(rewards), prompt_lens, total_lens
