@@ -32,27 +32,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from skypilot_tpu.train.flops import (PEAK_BF16_TFLOPS, chip_kind,
+                                      train_flops_per_token)
+
 REFERENCE_MFU = 2.225  # % — derived above from the reference's own numbers
-
-PEAK_BF16_TFLOPS = {
-    'v5litepod': 197.0,
-    'v5e': 197.0,
-    'v6e': 918.0,
-    'v5p': 459.0,
-    'v4': 275.0,
-    'cpu': 1.0,  # nominal, so the bench runs anywhere
-}
-
-
-def _chip_kind() -> str:
-    dev = jax.devices()[0]
-    kind = getattr(dev, 'device_kind', 'cpu').lower().replace(' ', '')
-    for name in PEAK_BF16_TFLOPS:
-        if name in kind:
-            return name
-    if 'lite' in kind:      # 'TPU v5 lite'
-        return 'v5litepod'
-    return 'cpu'
 
 
 _CATALOG_GENERATION = {'v5e': 'v5litepod'}  # device-kind name != SKU name
@@ -107,10 +90,9 @@ def bench_train(on_tpu: bool, seq: int = None, batch: int = None,
 
     tokens_per_s = batch * seq / dt
     n_params = cfg.num_params()
-    # fwd+bwd model flops/token: 6N dense + causal attention term.
-    flops_per_token = 6 * n_params + 6 * cfg.n_layers * seq * cfg.dim
-    model_tflops = tokens_per_s * flops_per_token / 1e12
-    kind = _chip_kind()
+    model_tflops = tokens_per_s * train_flops_per_token(
+        n_params, cfg.n_layers, cfg.dim, seq) / 1e12
+    kind = chip_kind()
     peak = PEAK_BF16_TFLOPS[kind]
     mfu = 100.0 * model_tflops / peak
     price, spot_price = _chip_price_per_hr(kind)
@@ -234,7 +216,7 @@ def bench_serve(on_tpu: bool) -> dict:
     p_tpots = sorted(
         (r.finished_at - r.first_token_at) * 1e3 / (r.emitted - 1)
         for r in p_reqs if r.finished_at is not None and r.emitted > 1)
-    kind = _chip_kind()
+    kind = chip_kind()
     base = _SERVE_BASELINE
     per_chip_base = base['out_tok_per_s'] / base['n_chips']
     bw_base = base['out_tok_per_s'] / (base['chip_hbm_gbps'] *

@@ -46,6 +46,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from skypilot_tpu import sky_logging
+from skypilot_tpu.server import metrics as metrics_lib
 
 logger = sky_logging.init_logger(__name__)
 
@@ -134,6 +135,7 @@ class DecodeEngine:
         self._inflight = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._last_gauges: Optional[tuple] = None
         self.error: Optional[BaseException] = None
         self._fmt_params = None
         self._prefill_compiled: Dict[tuple, Any] = {}
@@ -336,6 +338,7 @@ class DecodeEngine:
                 raise RuntimeError(
                     f'decode engine is dead: {self.error!r}')
             self._prefill_q.put(req)
+        metrics_lib.inc_counter('skytpu_engine_requests_total')
         return req
 
     def generate(self, prompt_ids: List[int],
@@ -455,6 +458,9 @@ class DecodeEngine:
             jnp.asarray(valid), self._next_rng())
         for slot_id, req in group:
             self._slots[slot_id] = _Slot(req, len(req.prompt_ids))
+        metrics_lib.inc_counter('skytpu_engine_prefill_tokens_total',
+                                float(sum(len(r.prompt_ids)
+                                          for _, r in group)))
 
     def _emit(self, req: Request, tok: int) -> None:
         req.emitted += 1
@@ -467,8 +473,16 @@ class DecodeEngine:
     def _retire(self, slot_id: int, slot: Optional[_Slot] = None) -> None:
         slot = slot if slot is not None else self._slots[slot_id]
         slot.done = True
-        slot.request.finished_at = time.perf_counter()
-        slot.request.out.put(None)
+        req = slot.request
+        req.finished_at = time.perf_counter()
+        # Mean inter-token latency over the request's decode phase —
+        # host-side perf_counter stamps only, no device sync.
+        if req.first_token_at is not None and req.emitted > 1:
+            metrics_lib.observe_hist(
+                'skytpu_engine_inter_token_seconds',
+                (req.finished_at - req.first_token_at) /
+                (req.emitted - 1))
+        req.out.put(None)
         # Under handoff a successor may already occupy the index — only
         # clear the mapping when it still points at the finished slot.
         if self._slots[slot_id] is slot:
@@ -497,6 +511,20 @@ class DecodeEngine:
         for bucket, group in by_bucket.items():
             self._admit_group(bucket, group)
 
+    def _sample_gauges(self, n_active: int) -> None:
+        """Loop-thread occupancy/queue gauges; skipped when unchanged so
+        the idle 1 kHz loop does not hammer the registry lock."""
+        sample = (n_active, self._prefill_q.qsize())
+        if sample == self._last_gauges:
+            return
+        self._last_gauges = sample
+        metrics_lib.set_gauge('skytpu_engine_active_slots',
+                              float(n_active))
+        metrics_lib.set_gauge('skytpu_engine_batch_occupancy_ratio',
+                              n_active / self.cfg.n_slots)
+        metrics_lib.set_gauge('skytpu_engine_queue_depth',
+                              float(sample[1]))
+
     def step(self) -> int:
         """One SYNCHRONOUS engine iteration (admit + decode + process).
         Returns #active slots.  Exposed for tests and debugging; the
@@ -505,6 +533,7 @@ class DecodeEngine:
         self._admit_free()
         active = [i for i in range(self.cfg.n_slots)
                   if self._slots[i] is not None]
+        self._sample_gauges(len(active))
         if not active:
             return 0
         out, self._cache, self._last_d, self._lens_d = self._decode(
@@ -534,6 +563,7 @@ class DecodeEngine:
         """
         active = [i for i in range(self.cfg.n_slots)
                   if self._slots[i] is not None]
+        self._sample_gauges(len(active))
         dispatched = None
         if active:
             out_d, self._cache, self._last_d, self._lens_d = self._decode(
@@ -572,6 +602,7 @@ class DecodeEngine:
         identity — its rows are the bounded garbage of the one-call
         retire lag, never another request's tokens."""
         now = time.perf_counter()
+        emitted = 0
         for i, slot in snapshot.items():
             if slot.done:
                 continue                 # retired earlier: rows are garbage
@@ -579,15 +610,22 @@ class DecodeEngine:
             if slot.first_pending:
                 slot.first_pending = False
                 slot.request.first_token_at = now
+                metrics_lib.observe_hist(
+                    'skytpu_engine_ttft_seconds',
+                    now - slot.request.submitted_at)
             else:
                 start = 1                # row 0 was emitted last step
             for t in range(start, out.shape[0]):
                 tok = int(out[t, i])
                 slot.length += 1
                 self._emit(slot.request, tok)
+                emitted += 1
                 if self._finished(slot, tok):
                     self._retire(i, slot)
                     break                # rest of this call's tokens: waste
+        if emitted:
+            metrics_lib.inc_counter('skytpu_engine_decode_tokens_total',
+                                    float(emitted))
 
 
     def _loop(self):

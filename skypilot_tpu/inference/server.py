@@ -5,6 +5,11 @@ launched by examples/tpu/v6e/serve-llama2-7b.yaml).  Routes:
 
 - GET  /health        -> 200 once the engine thread is up (readiness
                          probes from serve's replica manager hit this).
+- GET  /metrics       -> Prometheus exposition: engine TTFT /
+                         inter-token-latency histograms, token counters,
+                         occupancy/queue gauges.  The serve load
+                         balancer scrapes this per replica and federates
+                         the series under a replica="<id>" label.
 - POST /v1/completions  {"prompt": "...", "max_tokens": N} or
                         {"prompt_ids": [...], "max_tokens": N}
                         -> {"ids": [...], "text": "...", "usage": {...}}
@@ -24,6 +29,7 @@ from aiohttp import web
 
 from skypilot_tpu import sky_logging
 from skypilot_tpu.inference.engine import DecodeEngine, EngineConfig
+from skypilot_tpu.server import metrics as metrics_lib
 
 logger = sky_logging.init_logger(__name__)
 
@@ -78,7 +84,12 @@ def build_app(engine: DecodeEngine) -> web.Application:
             },
         })
 
+    async def metrics_route(_request):
+        return web.Response(text=metrics_lib.render(),
+                            content_type='text/plain')
+
     app.router.add_get('/health', health)
+    app.router.add_get('/metrics', metrics_route)
     app.router.add_post('/v1/completions', completions)
     return app
 

@@ -42,6 +42,7 @@ from skypilot_tpu.jobs import recovery_strategy as recovery_lib
 from skypilot_tpu.jobs import state
 from skypilot_tpu.jobs.recovery_strategy import StrategyExecutor
 from skypilot_tpu.jobs.state import ManagedJobStatus
+from skypilot_tpu.server import metrics as metrics_lib
 
 logger = sky_logging.init_logger(__name__)
 
@@ -284,6 +285,8 @@ class JobController:
                 unknown_streak += 1
                 if unknown_streak >= _LOST_JOB_POLLS:
                     n = state.bump_recovery_count(job_id)
+                    metrics_lib.inc_counter('skytpu_jobs_recoveries_total',
+                                            reason='lost_job')
                     logger.warning(
                         f'Managed job {job_id}: cluster {cluster_name!r} '
                         f'is UP but its agent has no record of job '
@@ -299,6 +302,9 @@ class JobController:
                 unknown_streak = 0
             if cl_status is not ClusterStatus.UP:
                 n = state.bump_recovery_count(job_id)
+                metrics_lib.inc_counter('skytpu_jobs_preemptions_total')
+                metrics_lib.inc_counter('skytpu_jobs_recoveries_total',
+                                        reason='preemption')
                 logger.warning(
                     f'Managed job {job_id}: cluster {cluster_name!r} '
                     f'lost (status={cl_status}); recovery #{n}.')
@@ -336,6 +342,8 @@ class JobController:
                         f'{status.value} (restarted {n - 1}x)')
                     strategy.cleanup()
                     return _TaskOutcome.FAILED
+                metrics_lib.inc_counter('skytpu_jobs_recoveries_total',
+                                        reason='user_failure')
                 logger.info(
                     f'Managed job {job_id}: user-code failure, '
                     f'restart {n}/{max_restarts}.')

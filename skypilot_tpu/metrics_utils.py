@@ -32,28 +32,55 @@ _CLUSTER_LABEL = 'skytpu-cluster'
 _last_scraped_pods: set = set()
 
 
-def _parse_cpu(q: str) -> float:
-    """k8s cpu quantity -> millicores ('250m' -> 250, '2' -> 2000)."""
-    q = str(q)
-    if q.endswith('n'):
-        return float(q[:-1]) / 1e6
-    if q.endswith('u'):
-        return float(q[:-1]) / 1e3
-    if q.endswith('m'):
-        return float(q[:-1])
-    return float(q) * 1000.0
+def _parse_cpu(q) -> float:
+    """k8s cpu quantity -> millicores ('250m' -> 250, '2' -> 2000).
+
+    Metrics come from an external API: malformed/empty quantities parse
+    to 0.0 — a garbled pod must not raise out of the whole scrape."""
+    q = str(q).strip()
+    if not q:
+        return 0.0
+    try:
+        if q.endswith('n'):
+            return max(0.0, float(q[:-1]) / 1e6)
+        if q.endswith('u'):
+            return max(0.0, float(q[:-1]) / 1e3)
+        if q.endswith('m'):
+            return max(0.0, float(q[:-1]))
+        return max(0.0, float(q) * 1000.0)
+    except ValueError:
+        return 0.0
 
 
 _MEM_SUFFIX = {'Ki': 2**10, 'Mi': 2**20, 'Gi': 2**30, 'Ti': 2**40,
-               'K': 1e3, 'M': 1e6, 'G': 1e9, 'T': 1e12}
+               'Pi': 2**50, 'Ei': 2**60,
+               'K': 1e3, 'k': 1e3, 'M': 1e6, 'G': 1e9, 'T': 1e12,
+               'P': 1e15, 'E': 1e18,
+               # Decimal sub-unit suffixes are legal quantities too —
+               # metrics-server emits millibyte forms from cgroup math.
+               'm': 1e-3, 'u': 1e-6, 'n': 1e-9}
 
 
-def _parse_mem(q: str) -> float:
-    q = str(q)
-    m = re.match(r'^([0-9.]+)([A-Za-z]*)$', q)
-    if not m:
+def _parse_mem(q) -> float:
+    """k8s memory quantity -> bytes.  Malformed/empty -> 0.0; an
+    UNKNOWN suffix also parses to 0.0 rather than silently dropping the
+    multiplier ('10Xi' as 10 bytes would underreport by orders of
+    magnitude)."""
+    q = str(q).strip()
+    if not q:
         return 0.0
+    m = re.match(r'^([0-9]*\.?[0-9]+)([A-Za-z]*)$', q)
+    if m is None:
+        # Plain/scientific float without a suffix ('1e3' defeats the
+        # suffix regex but is a legal quantity).
+        try:
+            return max(0.0, float(q))
+        except ValueError:
+            return 0.0
     val, suffix = float(m.group(1)), m.group(2)
+    if suffix and suffix not in _MEM_SUFFIX:
+        logger.debug(f'unknown memory suffix in quantity {q!r}')
+        return 0.0
     return val * _MEM_SUFFIX.get(suffix, 1.0)
 
 

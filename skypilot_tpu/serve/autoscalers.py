@@ -1,17 +1,20 @@
 """Autoscalers (capability parity: sky/serve/autoscalers.py —
 RequestRateAutoscaler :455, hysteresis :369).
 
-Pure decision logic, no I/O: the controller feeds it the request
-timestamps recorded by the load balancer plus current replica counts, and
-applies the returned delta.  That keeps it unit-testable over synthetic
-request traces (reference test: tests/test_serve_autoscaler.py).
+Pure decision logic, no I/O: the controller feeds it either the load
+balancer's monotonic request counter (`evaluate_counter`, the production
+path — the same skytpu_lb_requests_total family /metrics exports, so the
+autoscaler and the dashboards read one source of truth) or a raw request
+timestamp trace (`evaluate`, kept for synthetic-trace unit tests), plus
+current replica counts, and applies the returned delta.
 """
 from __future__ import annotations
 
+import collections
 import dataclasses
 import math
 import time
-from typing import List, Optional
+from typing import Deque, List, Optional, Tuple
 
 from skypilot_tpu.serve.service_spec import ServiceSpec
 
@@ -52,6 +55,21 @@ class Autoscaler:
             self.target_num_replicas,
             self.target_num_replicas - num_live_replicas)
 
+    def evaluate_counter(self, total_requests: int,
+                         num_live_replicas: int,
+                         now: Optional[float] = None) -> AutoscalerDecision:
+        """Counter-based twin of evaluate(): fed the LB's monotonic
+        proxied-request count.  The fixed policy ignores load."""
+        del total_requests
+        return self.evaluate([], num_live_replicas, now)
+
+    def adopt_history(self, old: 'Autoscaler') -> None:
+        """Carry scaling state over from the autoscaler this one
+        replaces (`serve update` rebuilds every spec-derived object).
+        The fixed policy pins to its configured count: nothing to
+        adopt."""
+        del old
+
 
 class RequestRateAutoscaler(Autoscaler):
     """Scale on measured QPS with hysteresis.
@@ -79,6 +97,9 @@ class RequestRateAutoscaler(Autoscaler):
                              decision_interval_seconds)))
         self.upscale_counter = 0
         self.downscale_counter = 0
+        # (time, cumulative request count) samples, pruned to the QPS
+        # window: the counter-based QPS source (evaluate_counter).
+        self._count_samples: Deque[Tuple[float, int]] = collections.deque()
 
     def current_qps(self, request_timestamps: List[float],
                     now: Optional[float] = None) -> float:
@@ -87,10 +108,61 @@ class RequestRateAutoscaler(Autoscaler):
         n = sum(1 for t in request_timestamps if t >= cutoff)
         return n / self.qps_window_seconds
 
+    def adopt_history(self, old: 'Autoscaler') -> None:
+        """Carry QPS samples, the current target, and the hysteresis
+        counters over from the replaced autoscaler: an empty window
+        would read 0 QPS, and a target reset to min_replicas would
+        emit an immediate scale-down of a loaded service right after
+        every `serve update` (then re-provision minutes later).  The
+        adopted target is clamped to the NEW spec's bounds — the
+        update may have changed min/max_replicas."""
+        self.target_num_replicas = max(
+            self.spec.min_replicas,
+            min(self.spec.max_replicas, old.target_num_replicas))
+        theirs = getattr(old, '_count_samples', None)
+        if theirs is not None:
+            self._count_samples.extend(theirs)
+        self.upscale_counter = getattr(old, 'upscale_counter', 0)
+        self.downscale_counter = getattr(old, 'downscale_counter', 0)
+
+    def record_request_count(self, total_requests: int,
+                             now: Optional[float] = None) -> None:
+        """Sample the LB's monotonic request counter.  Keeps one sample
+        at (or just outside) the window edge as the rate baseline."""
+        now = time.time() if now is None else now
+        self._count_samples.append((now, total_requests))
+        cutoff = now - self.qps_window_seconds
+        while len(self._count_samples) >= 2 and \
+                self._count_samples[1][0] <= cutoff:
+            self._count_samples.popleft()
+
+    def current_qps_from_counter(self) -> float:
+        """Requests/sec over the sampled window (same window-averaged
+        semantics as the timestamp-trace estimate).  The divisor is
+        floored at the window but follows the REAL sample span when
+        ticks stalled (rollout, controller pause): dividing a
+        multi-window delta by one window would report a post-stall QPS
+        spike and spuriously scale up."""
+        if len(self._count_samples) < 2:
+            return 0.0
+        (t0, c0), (t1, c1) = self._count_samples[0], self._count_samples[-1]
+        return max(0, c1 - c0) / max(self.qps_window_seconds, t1 - t0)
+
     def evaluate(self, request_timestamps: List[float],
                  num_live_replicas: int,
                  now: Optional[float] = None) -> AutoscalerDecision:
-        qps = self.current_qps(request_timestamps, now)
+        return self._decide(self.current_qps(request_timestamps, now),
+                            num_live_replicas)
+
+    def evaluate_counter(self, total_requests: int,
+                         num_live_replicas: int,
+                         now: Optional[float] = None) -> AutoscalerDecision:
+        self.record_request_count(total_requests, now)
+        return self._decide(self.current_qps_from_counter(),
+                            num_live_replicas)
+
+    def _decide(self, qps: float,
+                num_live_replicas: int) -> AutoscalerDecision:
         desired = int(math.ceil(qps / self.spec.target_qps_per_replica))
         desired = max(self.spec.min_replicas,
                       min(self.spec.max_replicas, desired))

@@ -61,7 +61,8 @@ class ServiceController:
         self.lb = LoadBalancer(
             service_name, rec['lb_port'],
             LoadBalancingPolicy.make(self.spec.load_balancing_policy),
-            self.manager.ready_urls)
+            self.manager.ready_urls,
+            ready_replicas_fn=self.manager.ready_replicas)
         self.autoscaler = Autoscaler.make(self.spec, _tick_interval(),
                                           _qps_window())
 
@@ -126,8 +127,12 @@ class ServiceController:
                                           self.version)
                 self.lb.policy = LoadBalancingPolicy.make(
                     self.spec.load_balancing_policy)
-                self.autoscaler = Autoscaler.make(
+                new_autoscaler = Autoscaler.make(
                     self.spec, _tick_interval(), _qps_window())
+                # Keep the QPS sample history: an empty window would
+                # read 0 QPS and spuriously downscale after the update.
+                new_autoscaler.adopt_history(self.autoscaler)
+                self.autoscaler = new_autoscaler
             now = time.time()
             self.manager.probe_and_reconcile(now)
             if self.manager.rollout_step():
@@ -137,9 +142,10 @@ class ServiceController:
                 self._update_service_status()
                 _shutdown.wait(_tick_interval())
                 continue
-            decision = self.autoscaler.evaluate(
-                list(self.lb.request_timestamps), self.manager.num_live(),
-                now)
+            # QPS from the LB's monotonic request counter — the same
+            # series /metrics exports, not a parallel timestamp trace.
+            decision = self.autoscaler.evaluate_counter(
+                self.lb.proxied_requests(), self.manager.num_live(), now)
             if decision.delta > 0:
                 logger.info(f'Service {self.service_name!r}: scaling up '
                             f'by {decision.delta} to '

@@ -3,43 +3,61 @@
 
 One LB per service, running an aiohttp server on its own thread + event
 loop so it works identically library-direct and inside the API server.
-Every proxied request is timestamped; the autoscaler reads that trace to
-estimate QPS.
+
+Observability: every proxied request lands in the shared Prometheus
+registry (skytpu_lb_requests_total by replica/status code, per-replica
+duration histograms); the autoscaler estimates QPS from the same request
+counter instead of keeping a parallel timestamp trace.  GET /metrics on
+the LB is handled locally and FEDERATES: it scrapes each ready replica's
+/metrics and re-exports those series relabeled with replica="<id>", so
+one scrape observes the whole service (engine TTFT/TPOT histograms
+included).
 """
 from __future__ import annotations
 
 import asyncio
-import collections
 import threading
 import time
-from typing import Callable, Deque, List, Optional
+from typing import Callable, List, Optional, Tuple
 
 import aiohttp
 from aiohttp import web
 
 from skypilot_tpu import sky_logging
 from skypilot_tpu.serve.load_balancing_policies import LoadBalancingPolicy
+from skypilot_tpu.server import metrics as metrics_lib
 
 logger = sky_logging.init_logger(__name__)
 
-# Request timestamps kept for QPS estimation (bounded memory).
-_MAX_TIMESTAMPS = 100_000
 _HOP_HEADERS = {'connection', 'keep-alive', 'transfer-encoding', 'host',
                 'proxy-authenticate', 'proxy-authorization', 'te',
                 'trailers', 'upgrade'}
+# Per-replica /metrics scrape budget for one federated LB scrape.
+_FEDERATE_TIMEOUT_SECONDS = 2.0
+# Advisory client back-off when no replica is ready (matches the
+# controller tick that could bring one up).
+_RETRY_AFTER_SECONDS = 5
 
 
 class LoadBalancer:
 
     def __init__(self, service_name: str, port: int,
                  policy: LoadBalancingPolicy,
-                 ready_urls_fn: Callable[[], List[str]]) -> None:
+                 ready_urls_fn: Callable[[], List[str]],
+                 ready_replicas_fn: Optional[
+                     Callable[[], List[Tuple[int, str]]]] = None) -> None:
         self.service_name = service_name
         self.port = port
         self.policy = policy
         self._ready_urls_fn = ready_urls_fn
-        self.request_timestamps: Deque[float] = collections.deque(
-            maxlen=_MAX_TIMESTAMPS)
+        # Optional richer view: [(replica_id, url)].  Used to label
+        # per-replica series and to federate /metrics; without it the
+        # replica label falls back to the url.
+        self._ready_replicas_fn = ready_replicas_fn
+        # Monotonic proxied-request count (mirrors the
+        # skytpu_lb_requests_total family).  The autoscaler samples this
+        # instead of a parallel timestamp deque.
+        self._request_count = 0
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
         self._started = threading.Event()
@@ -48,17 +66,44 @@ class LoadBalancer:
         # own event loop and closed in stop().
         self._session: Optional[aiohttp.ClientSession] = None
 
+    # ----- observability ------------------------------------------------------
+    def proxied_requests(self) -> int:
+        """Total requests proxied (including rejected 503s): the
+        autoscaler's QPS source."""
+        return self._request_count
+
+    def _ready(self) -> Tuple[List[str], dict]:
+        """One state read per request: (urls, url -> replica label)."""
+        if self._ready_replicas_fn is not None:
+            pairs = self._ready_replicas_fn()
+            return [u for _, u in pairs], {u: str(r) for r, u in pairs}
+        return self._ready_urls_fn(), {}
+
     # ----- data plane ---------------------------------------------------------
     async def _handle(self, request: web.Request) -> web.StreamResponse:
-        self.request_timestamps.append(time.time())
-        urls = self._ready_urls_fn()
+        self._request_count += 1
+        urls, labels = self._ready()
         url = self.policy.select(urls)
         if url is None:
+            metrics_lib.inc_counter('skytpu_lb_no_ready_replicas_total',
+                                    service=self.service_name)
+            # Rejections land in the requests_total family too (under
+            # replica="none"), so sum(skytpu_lb_requests_total) equals
+            # the demand signal the autoscaler reads — rejected demand
+            # still argues for scale-up.
+            metrics_lib.inc_counter('skytpu_lb_requests_total',
+                                    service=self.service_name,
+                                    replica='none', code='503')
             return web.json_response(
                 {'error': f'no ready replicas for {self.service_name}'},
-                status=503)
+                status=503,
+                headers={'Retry-After': str(_RETRY_AFTER_SECONDS)})
         target = url.rstrip('/') + '/' + str(request.rel_url).lstrip('/')
+        replica = labels.get(url, url)
         self.policy.on_request_start(url)
+        t0 = time.perf_counter()
+        code = '502'
+        resp: Optional[web.StreamResponse] = None
         try:
             headers = {k: v for k, v in request.headers.items()
                        if k.lower() not in _HOP_HEADERS}
@@ -68,6 +113,7 @@ class LoadBalancer:
                     request.method, target, headers=headers,
                     data=body if body else None,
                     allow_redirects=False) as upstream:
+                code = str(upstream.status)
                 resp = web.StreamResponse(status=upstream.status)
                 for k, v in upstream.headers.items():
                     if k.lower() not in _HOP_HEADERS and \
@@ -79,13 +125,78 @@ class LoadBalancer:
                     await resp.write(chunk)
                 await resp.write_eof()
                 return resp
-        except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+        except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+            # Upstream (replica) failure — including a replica that died
+            # MID-STREAM after latching its 200: re-latch to 502 so the
+            # per-replica counter exposes the failure, not a success.
+            code = '502'
             logger.warning(f'LB {self.service_name}: replica {url} '
                            f'errored: {e}')
             return web.json_response(
                 {'error': f'replica request failed: {e}'}, status=502)
+        except OSError as e:
+            # Raw OSError here is a CLIENT-side socket failure: upstream
+            # I/O errors arrive wrapped as aiohttp.ClientError (caught
+            # above).  Either way the replica is healthy — don't let
+            # client churn show up as per-replica 5xx.
+            if resp is not None and resp.prepared:
+                # Disconnect mid-stream (common for streaming
+                # completions): keep the replica's real status.
+                logger.debug(f'LB {self.service_name}: client '
+                             f'disconnected mid-stream: {e}')
+                return resp
+            # Abort before the response started (e.g. mid-upload):
+            # 499 = client closed request.
+            code = '499'
+            logger.debug(f'LB {self.service_name}: client aborted '
+                         f'before response: {e}')
+            return web.Response(status=499)
         finally:
             self.policy.on_request_end(url)
+            metrics_lib.observe_hist(
+                'skytpu_lb_request_duration_seconds',
+                time.perf_counter() - t0,
+                service=self.service_name, replica=replica)
+            metrics_lib.inc_counter(
+                'skytpu_lb_requests_total',
+                service=self.service_name, replica=replica, code=code)
+
+    async def _metrics(self, _request: web.Request) -> web.Response:
+        """Federated scrape: own registry + each ready replica's
+        /metrics relabeled with replica="<id>".  A replica that is
+        down, slow, or serving a non-exposition payload is skipped —
+        one bad replica must not fail the whole service's scrape."""
+        if self._ready_replicas_fn is not None:
+            replicas = list(self._ready_replicas_fn())
+        else:
+            # No id view: label by URL (stable across scrapes and
+            # consistent with the proxy path's fallback; a positional
+            # index would splice one replica's history into another's
+            # whenever the ready set changes).
+            replicas = [(u, u) for u in self._ready_urls_fn()]
+
+        async def scrape(rid, url):
+            try:
+                assert self._session is not None
+                async with self._session.get(
+                        url.rstrip('/') + '/metrics',
+                        timeout=aiohttp.ClientTimeout(
+                            total=_FEDERATE_TIMEOUT_SECONDS)) as resp:
+                    if resp.status == 200:
+                        return (str(rid), await resp.text())
+            except (aiohttp.ClientError, asyncio.TimeoutError,
+                    OSError) as e:
+                logger.debug(f'LB {self.service_name}: replica {rid} '
+                             f'metrics scrape failed: {e}')
+            return None
+
+        # Concurrent scrapes: one slow replica costs the whole-service
+        # scrape _FEDERATE_TIMEOUT_SECONDS, not timeout x replicas.
+        texts = [t for t in await asyncio.gather(
+            *(scrape(rid, url) for rid, url in replicas)) if t]
+        return web.Response(
+            text=metrics_lib.merge_federated(metrics_lib.render(), texts),
+            content_type='text/plain')
 
     # ----- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -106,6 +217,9 @@ class LoadBalancer:
         async def _start():
             self._session = aiohttp.ClientSession()
             app = web.Application()
+            # /metrics is served locally (and federates the replicas);
+            # registered before the catch-all proxy route.
+            app.router.add_get('/metrics', self._metrics)
             app.router.add_route('*', '/{tail:.*}', self._handle)
             runner = web.AppRunner(app)
             await runner.setup()

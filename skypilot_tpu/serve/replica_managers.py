@@ -13,7 +13,7 @@ let the autoscaler's next tick replace it.
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import requests as requests_lib
 
@@ -207,6 +207,10 @@ class ReplicaManager:
                  else ReplicaStatus.SHUTDOWN)
         serve_state.set_replica_status(self.service_name, replica_id,
                                        final)
+        if preempted:
+            from skypilot_tpu.server import metrics as metrics_lib
+            metrics_lib.inc_counter('skytpu_serve_replica_preemptions_total',
+                                    service=self.service_name)
         if self.spot_placer is not None and rec['is_spot']:
             if preempted:
                 self.spot_placer.handle_preemption(rec['zone'])
@@ -394,8 +398,14 @@ class ReplicaManager:
 
     # ----- views --------------------------------------------------------------
     def ready_urls(self) -> List[str]:
+        return [url for _, url in self.ready_replicas()]
+
+    def ready_replicas(self) -> List[Tuple[int, str]]:
+        """(replica_id, url) pairs for READY replicas — the LB labels
+        per-replica metric series and federates /metrics from these."""
         return [
-            r['url'] for r in serve_state.get_replicas(self.service_name)
+            (r['replica_id'], r['url'])
+            for r in serve_state.get_replicas(self.service_name)
             if r['status'] is ReplicaStatus.READY and r['url']
         ]
 

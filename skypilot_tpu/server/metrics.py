@@ -1,14 +1,28 @@
-"""Prometheus metrics for the API server (parity: sky/server/metrics.py).
+"""Prometheus metrics registry (parity: sky/server/metrics.py, grown
+into the data-plane observability substrate).
 
 No prometheus_client dependency: the registry renders the text
-exposition format directly (counters + gauges + duration summaries are
-all this server needs).  Scrape GET /metrics.
+exposition format directly.  Four instrument kinds:
+
+- counters (`inc_counter`) — monotonic, family names end `_total`;
+- gauges (`set_gauge`/`add_gauge`/`remove_gauge`);
+- summaries (`observe`) — count+sum only (no percentiles);
+- histograms (`observe_hist`) — fixed bucket sets with full
+  `_bucket`/`_sum`/`_count` exposition, so TTFT/TPOT/step-time
+  percentiles are computable server-side from one scrape.
+
+Every exported family MUST have a `_HELP` entry (the registry is
+central on purpose: tests/test_observability.py walks it and the call
+sites to enforce naming + help coverage).  Scrape GET /metrics on the
+API server, the inference server, or a service's load balancer (which
+federates its replicas — see merge_federated).
 """
 from __future__ import annotations
 
+import re
 import threading
 import time
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 _lock = threading.Lock()
 # (metric, labels-tuple) -> float
@@ -17,13 +31,83 @@ _gauges: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
 # (metric, labels) -> (count, sum)
 _summaries: Dict[Tuple[str, Tuple[Tuple[str, str], ...]],
                  List[float]] = {}
+# (metric, labels) -> [per-bucket counts (len(buckets)+1, last = +Inf),
+#                      sum]; counts are NON-cumulative in storage and
+#                      rendered cumulatively.
+_histograms: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], list] = {}
 
 _HELP = {
+    # ----- API server (control plane) ------------------------------------
     'skytpu_requests_total':
         'API requests by route handler and terminal status',
     'skytpu_requests_in_flight': 'Requests currently executing',
     'skytpu_request_duration_seconds': 'Request wall time',
     'skytpu_server_start_time_seconds': 'Unix time the server started',
+    # ----- k8s pod scraping (metrics_utils) ------------------------------
+    'skytpu_k8s_pod_tpu_chips':
+        'TPU chips requested by a skytpu-managed pod',
+    'skytpu_k8s_pod_cpu_millicores':
+        'Pod CPU usage from metrics-server, in millicores',
+    'skytpu_k8s_pod_memory_bytes':
+        'Pod memory usage from metrics-server, in bytes',
+    # ----- decode engine (data plane) ------------------------------------
+    'skytpu_engine_ttft_seconds':
+        'Time from submit to first emitted token',
+    'skytpu_engine_inter_token_seconds':
+        'Mean inter-token latency per finished request '
+        '((finish - first token) / (tokens - 1))',
+    'skytpu_engine_prefill_tokens_total':
+        'Prompt tokens prefilled into decode slots',
+    'skytpu_engine_decode_tokens_total':
+        'Tokens emitted by the decode loop',
+    'skytpu_engine_requests_total':
+        'Requests admitted to the engine queue',
+    'skytpu_engine_batch_occupancy_ratio':
+        'Active decode slots / total slots, sampled each loop step',
+    'skytpu_engine_active_slots': 'Decode slots occupied this step',
+    'skytpu_engine_queue_depth':
+        'Requests waiting in the prefill queue',
+    # ----- serve load balancer -------------------------------------------
+    'skytpu_lb_requests_total':
+        'Proxied requests by replica and upstream status code',
+    'skytpu_lb_request_duration_seconds':
+        'Proxied request wall time, per replica',
+    'skytpu_lb_no_ready_replicas_total':
+        'Requests rejected 503 because no replica was ready',
+    # ----- training -------------------------------------------------------
+    'skytpu_train_step_seconds': 'Train step wall time',
+    'skytpu_train_tokens_per_second':
+        'Training throughput over the recent logging window',
+    'skytpu_train_mfu_percent':
+        'Estimated model FLOPs utilization (bench.py accounting)',
+    # ----- managed jobs ----------------------------------------------------
+    'skytpu_jobs_preemptions_total':
+        'Task clusters lost to preemption (cloud says not-UP)',
+    'skytpu_jobs_recoveries_total':
+        'Managed-job recoveries by trigger '
+        '(preemption / lost_job / user_failure)',
+    'skytpu_jobs_recovery_launches_total':
+        'Recovery relaunches by strategy (slice delete + re-provision)',
+    # ----- serve replicas --------------------------------------------------
+    'skytpu_serve_replica_preemptions_total':
+        'Serve replicas lost to preemption',
+}
+
+# Fixed bucket upper bounds per histogram family (seconds unless the
+# family name says otherwise).  Central so the exposition is stable
+# across replicas — federation sums only make sense on shared buckets.
+DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                   5.0, 10.0, 30.0, 60.0)
+_BUCKETS: Dict[str, Tuple[float, ...]] = {
+    'skytpu_engine_ttft_seconds':
+        (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0),
+    'skytpu_engine_inter_token_seconds':
+        (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+         0.5, 1.0),
+    'skytpu_lb_request_duration_seconds': DEFAULT_BUCKETS,
+    'skytpu_train_step_seconds':
+        (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+         60.0, 120.0),
 }
 
 _started_at = time.time()
@@ -66,11 +150,48 @@ def observe(metric: str, value: float, **labels: str) -> None:
         _summaries[k][1] += value
 
 
-def _fmt_labels(labels: Tuple[Tuple[str, str], ...]) -> str:
-    if not labels:
+def buckets_for(metric: str) -> Tuple[float, ...]:
+    return _BUCKETS.get(metric, DEFAULT_BUCKETS)
+
+
+def observe_hist(metric: str, value: float, **labels: str) -> None:
+    """Record into a fixed-bucket histogram (bucket bounds from
+    _BUCKETS, DEFAULT_BUCKETS otherwise)."""
+    bounds = buckets_for(metric)
+    # Index of the first bucket the value fits; len(bounds) == +Inf.
+    idx = len(bounds)
+    for i, b in enumerate(bounds):
+        if value <= b:
+            idx = i
+            break
+    with _lock:
+        k = _key(metric, labels)
+        h = _histograms.get(k)
+        if h is None:
+            h = [[0] * (len(bounds) + 1), 0.0]
+            _histograms[k] = h
+        h[0][idx] += 1
+        h[1] += value
+
+
+def _escape_label_value(v: str) -> str:
+    return str(v).replace('\\', '\\\\').replace('"', '\\"').replace(
+        '\n', '\\n')
+
+
+def _fmt_labels(labels: Tuple[Tuple[str, str], ...],
+                extra: Tuple[Tuple[str, str], ...] = ()) -> str:
+    pairs = tuple(labels) + tuple(extra)
+    if not pairs:
         return ''
-    inner = ','.join(f'{k}="{v}"' for k, v in labels)
+    inner = ','.join(f'{k}="{_escape_label_value(v)}"' for k, v in pairs)
     return '{' + inner + '}'
+
+
+def _fmt_bucket_value(b: float) -> str:
+    # 1.0 -> "1.0" is fine, but trim trailing noise: match Prometheus
+    # client conventions loosely (repr of the float).
+    return repr(float(b))
 
 
 def render() -> str:
@@ -98,7 +219,115 @@ def render() -> str:
             header(name, 'summary')
             lines.append(f'{name}_count{_fmt_labels(labels)} {count}')
             lines.append(f'{name}_sum{_fmt_labels(labels)} {total}')
+        for (name, labels), (counts, total) in sorted(_histograms.items()):
+            header(name, 'histogram')
+            bounds = buckets_for(name)
+            cum = 0
+            for i, b in enumerate(bounds):
+                cum += counts[i]
+                le = (('le', _fmt_bucket_value(b)),)
+                lines.append(
+                    f'{name}_bucket{_fmt_labels(labels, le)} {cum}')
+            cum += counts[-1]
+            lines.append(
+                f'{name}_bucket'
+                f'{_fmt_labels(labels, (("le", "+Inf"),))} {cum}')
+            lines.append(f'{name}_sum{_fmt_labels(labels)} {total}')
+            lines.append(f'{name}_count{_fmt_labels(labels)} {cum}')
     return '\n'.join(lines) + '\n'
+
+
+# ----- federation -------------------------------------------------------------
+# A sample line: name, optional {labels}, value (+ optional timestamp).
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})?\s+(-?[0-9.eE+\-]+|NaN|[+\-]Inf)'
+    r'(\s+-?[0-9]+)?\s*$')
+_META_RE = re.compile(r'^#\s+(HELP|TYPE)\s+([a-zA-Z_:][a-zA-Z0-9_:]*)\s+(.*)$')
+
+
+def _relabel_sample(line: str, extra: str) -> str:
+    """Insert pre-escaped label text `k="v"` into one sample line."""
+    m = _SAMPLE_RE.match(line)
+    assert m is not None, line
+    name, labels = m.group(1), m.group(2)
+    if labels and labels != '{}':
+        rest = line[m.end(2):]
+        return f'{name}{labels[:-1]},{extra}}}{rest}'
+    rest = line[m.end(2) if labels else m.end(1):]
+    return f'{name}{{{extra}}}{rest}'
+
+
+def merge_federated(own: str,
+                    replicas: List[Tuple[str, str]]) -> str:
+    """Merge this process's exposition with scraped replica expositions.
+
+    ``replicas`` is [(replica_id, exposition_text)]; every replica
+    sample is relabeled with replica="<id>" and the result is regrouped
+    per family (one HELP/TYPE header, all samples together) so the
+    output stays parseable by strict exposition consumers.  Unparseable
+    replica lines (a workload without /metrics answered something else)
+    are dropped.
+    """
+    families: Dict[str, dict] = {}
+    order: List[str] = []
+
+    def fam(name: str) -> dict:
+        if name not in families:
+            families[name] = {'help': None, 'type': None, 'lines': []}
+            order.append(name)
+        return families[name]
+
+    def feed(text: str, replica_id: Optional[str]) -> None:
+        current: Optional[str] = None
+        for line in text.splitlines():
+            line = line.rstrip()
+            if not line:
+                continue
+            meta = _META_RE.match(line)
+            if meta is not None:
+                kind, name, rest = meta.groups()
+                f = fam(name)
+                key = kind.lower()
+                if f[key] is None:
+                    f[key] = rest
+                current = name
+                continue
+            if line.startswith('#'):
+                continue
+            m = _SAMPLE_RE.match(line)
+            if m is None:
+                continue                      # not exposition text: drop
+            name = m.group(1)
+            # _bucket/_sum/_count samples belong to the preceding
+            # family header (our renderer always emits header-first).
+            owner = current if (current is not None and
+                                name.startswith(current)) else name
+            if replica_id is not None and \
+                    (m.group(2) is None or
+                     re.search(r'[{,]replica="', m.group(2)) is None):
+                # Never emit a duplicate label name: a sample already
+                # carrying replica= (e.g. nested federation) keeps it.
+                line = _relabel_sample(
+                    line, f'replica="{_escape_label_value(replica_id)}"')
+            fam(owner)['lines'].append(line)
+
+    feed(own, None)
+    for rid, text in replicas:
+        feed(text, rid)
+    out: List[str] = []
+    for name in order:
+        f = families[name]
+        if f['help'] is not None:
+            out.append(f'# HELP {name} {f["help"]}')
+        if f['type'] is not None:
+            out.append(f'# TYPE {name} {f["type"]}')
+        out.extend(f['lines'])
+    return '\n'.join(out) + '\n'
+
+
+def help_registry() -> Dict[str, str]:
+    """The central family -> help map (tests walk this)."""
+    return dict(_HELP)
 
 
 def reset_for_tests() -> None:
@@ -106,3 +335,4 @@ def reset_for_tests() -> None:
         _counters.clear()
         _gauges.clear()
         _summaries.clear()
+        _histograms.clear()

@@ -1,0 +1,57 @@
+"""Model-FLOP accounting shared by bench.py and the trainer's MFU
+gauges, so the benchmark and the live skytpu_train_mfu_percent series
+report the same quantity.
+
+MFU here is *model* FLOPs utilization: achieved model FLOPs/s (6N dense
+fwd+bwd plus the causal-attention term) over the chip's peak bf16
+throughput.  Hardware-neutral — the reference's published v6e numbers
+reduce to the same measure (see bench.py's baseline derivation).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+PEAK_BF16_TFLOPS = {
+    'v5litepod': 197.0,
+    'v5e': 197.0,
+    'v6e': 918.0,
+    'v5p': 459.0,
+    'v4': 275.0,
+    'cpu': 1.0,  # nominal, so accounting runs anywhere
+}
+
+
+def chip_kind() -> str:
+    """Normalized device-kind name of the first local device."""
+    import jax
+    dev = jax.devices()[0]
+    kind = getattr(dev, 'device_kind', 'cpu').lower().replace(' ', '')
+    for name in PEAK_BF16_TFLOPS:
+        if name in kind:
+            return name
+    if 'lite' in kind:      # 'TPU v5 lite'
+        return 'v5litepod'
+    return 'cpu'
+
+
+def train_flops_per_token(n_params: int, n_layers: int, dim: int,
+                          seq_len: int) -> float:
+    """fwd+bwd model FLOPs per trained token: 6N dense + causal
+    attention term."""
+    return 6 * n_params + 6 * n_layers * seq_len * dim
+
+
+def estimate_mfu(tokens_per_s: float, n_params: int, n_layers: int,
+                 dim: int, seq_len: int, n_chips: int = 1,
+                 kind: Optional[str] = None) -> float:
+    """Achieved model TFLOP/s as % of the slice's peak bf16 TFLOP/s.
+
+    Returns 0.0 on unrecognized hardware rather than a bogus ratio."""
+    kind = kind or chip_kind()
+    peak = PEAK_BF16_TFLOPS.get(kind)
+    if not peak or tokens_per_s <= 0:
+        return 0.0
+    achieved_tflops = (tokens_per_s *
+                       train_flops_per_token(n_params, n_layers, dim,
+                                             seq_len) / 1e12)
+    return 100.0 * achieved_tflops / (peak * max(1, n_chips))

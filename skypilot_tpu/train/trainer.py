@@ -161,22 +161,84 @@ class Trainer:
             checkpoint_every: int = 0,
             log_every: int = 10,
             log_fn: Callable[[dict], None] = None) -> dict:
+        from skypilot_tpu.server import metrics as metrics_lib
         metrics = {}
         t0 = time.perf_counter()
         tokens_seen = 0
+        prev = t0
+        # Gauges export WINDOWED throughput (since the last log
+        # boundary), matching their _HELP text — the cumulative average
+        # returned below would mask a mid-run stall and bakes step-0
+        # compile time into the denominator forever.
+        window_tokens = 0
+        window_start = t0
         for i in range(num_steps):
             batch = next(data)
             tokens_seen += batch.size
+            window_tokens += batch.size
             self.state, metrics = self.train_step(self.state, batch)
+            # Host wall time per iteration: async dispatch, but donated
+            # buffers backpressure the host to the device step rate at
+            # steady state — and no sync is added here.
+            now = time.perf_counter()
+            if i > 0:
+                metrics_lib.observe_hist('skytpu_train_step_seconds',
+                                         now - prev)
+            else:
+                # Step 0 is dominated by XLA trace+compile; one such
+                # sample would inflate the histogram sum (and the first
+                # throughput window) for the whole run.
+                window_tokens = 0
+                window_start = now
             if checkpoint_every and (i + 1) % checkpoint_every == 0:
                 self.save_checkpoint()
-            if log_fn and (i + 1) % log_every == 0:
-                m = jax.device_get(metrics)
-                m['tokens_per_s'] = tokens_seen / (time.perf_counter() - t0)
-                log_fn(m)
+            if (i + 1) % log_every == 0:
+                # Gauges export on every boundary, log_fn or not — a
+                # run launched without a log callback must still be
+                # scrapeable mid-flight.  (Donated buffers bound how
+                # far dispatch runs ahead, so the wall-clock window is
+                # honest without forcing a sync here.)
+                self._export_throughput(
+                    window_tokens / (time.perf_counter() - window_start),
+                    batch)
+                if log_fn:
+                    m = jax.device_get(metrics)
+                    m['tokens_per_s'] = tokens_seen / (
+                        time.perf_counter() - t0)
+                    log_fn(m)
+                window_tokens = 0
+                window_start = time.perf_counter()
+            # Re-stamp AFTER checkpoint/log work: a multi-second orbax
+            # save attributed to the next step would spike the step-time
+            # p99 every checkpoint interval.
+            prev = time.perf_counter()
         out = jax.device_get(metrics)
         out['tokens_per_s'] = tokens_seen / (time.perf_counter() - t0)
+        if window_tokens:
+            self._export_throughput(
+                window_tokens / (time.perf_counter() - window_start),
+                batch)
         return out
+
+    def _export_throughput(self, tokens_per_s: float, batch) -> None:
+        """tokens/sec + estimated-MFU gauges (bench.py's FLOP
+        accounting via train/flops.py).  Models without a LlamaConfig-
+        shaped cfg just skip the MFU gauge."""
+        from skypilot_tpu.server import metrics as metrics_lib
+        from skypilot_tpu.train import flops as flops_lib
+        metrics_lib.set_gauge('skytpu_train_tokens_per_second',
+                              tokens_per_s)
+        cfg = getattr(self.model, 'cfg', None)
+        if batch is None or cfg is None:
+            return
+        try:
+            mfu = flops_lib.estimate_mfu(
+                tokens_per_s, cfg.num_params(), cfg.n_layers, cfg.dim,
+                seq_len=batch.shape[-1], n_chips=self.mesh.size)
+        except (AttributeError, TypeError):
+            return      # cfg not LlamaConfig-shaped: no MFU gauge
+        if mfu > 0:
+            metrics_lib.set_gauge('skytpu_train_mfu_percent', mfu)
 
     def save_checkpoint(self) -> None:
         if self._ckpt_mgr is not None:

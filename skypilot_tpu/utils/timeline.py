@@ -24,6 +24,25 @@ from typing import Any, Callable, Dict, List, Optional
 _lock = threading.Lock()
 _events: List[Dict[str, Any]] = []
 _registered = False
+# Stable per-thread sequential track ids, held in thread-local storage.
+# (Perfetto tracks key on tid; hashing/truncating threading.get_ident()
+# — whose values the OS reuses and which collide under any modulus —
+# can merge two threads' events into one garbled track.  TLS dies with
+# its thread, so even ident REUSE cannot alias two threads.)
+_tid_counter = 0
+_tid_gen = 0          # bumped by reset_for_tests: invalidates old ids
+_tls = threading.local()
+
+
+def _tid() -> int:
+    global _tid_counter
+    rec = getattr(_tls, 'rec', None)
+    if rec is None or rec[0] != _tid_gen:
+        with _lock:
+            rec = (_tid_gen, _tid_counter)
+            _tid_counter += 1
+        _tls.rec = rec
+    return rec[1]
 
 
 def enabled() -> bool:
@@ -36,7 +55,7 @@ def _record(name: str, phase: str, args: Optional[dict] = None) -> None:
         'ph': phase,
         'ts': time.time() * 1e6,            # microseconds
         'pid': os.getpid(),
-        'tid': threading.get_ident() % 100000,
+        'tid': _tid(),
     }
     if args:
         evt['args'] = args
@@ -106,5 +125,8 @@ def dump(path: Optional[str] = None) -> Optional[str]:
 
 
 def reset_for_tests() -> None:
+    global _tid_counter, _tid_gen
     with _lock:
         _events.clear()
+        _tid_gen += 1     # live threads' cached ids become stale
+        _tid_counter = 0

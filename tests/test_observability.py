@@ -1,6 +1,16 @@
 """Timeline tracing + Prometheus metrics (parity:
-sky/utils/timeline.py:85, sky/server/metrics.py)."""
+sky/utils/timeline.py:85, sky/server/metrics.py), grown to the
+data-plane observability layer: histogram exposition, engine
+TTFT/TPOT instrumentation (single-sync invariant), and the load
+balancer's per-replica /metrics federation."""
+import asyncio
 import json
+import pathlib
+import re
+import socket
+import threading
+import urllib.error
+import urllib.request
 
 import pytest
 
@@ -140,3 +150,345 @@ def test_usage_records_events_and_heartbeat(tmp_home, enable_all_clouds,
     assert launch_ev['labels'] == {'team': 'ml'}
     hb = next(l for l in lines if l['event'] == 'heartbeat')
     assert hb['clusters'] >= 1
+
+
+# ----- histogram exposition ---------------------------------------------------
+def _parse_exposition(text):
+    """-> {(name, labels_str): float} for sample lines."""
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith('#'):
+            continue
+        m = re.match(r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})?\s+(\S+)$',
+                     line)
+        assert m is not None, f'unparseable sample line: {line!r}'
+        out[(m.group(1), m.group(2) or '')] = float(m.group(3))
+    return out
+
+
+def test_histogram_exposition_buckets_monotone_and_inf():
+    for v in (0.003, 0.02, 0.02, 0.4, 7.0, 1e9):
+        metrics.observe_hist('skytpu_lb_request_duration_seconds', v,
+                             service='svc', replica='0')
+    text = metrics.render()
+    assert '# TYPE skytpu_lb_request_duration_seconds histogram' in text
+    samples = _parse_exposition(text)
+    buckets = [(labels, val) for (name, labels), val in samples.items()
+               if name == 'skytpu_lb_request_duration_seconds_bucket']
+    assert buckets, text
+    # Cumulative counts must be non-decreasing in le order.
+    def le_of(labels):
+        m = re.search(r'le="([^"]+)"', labels)
+        return float('inf') if m.group(1) == '+Inf' else float(m.group(1))
+    ordered = sorted(buckets, key=lambda kv: le_of(kv[0]))
+    vals = [v for _, v in ordered]
+    assert vals == sorted(vals)
+    # +Inf bucket == _count; sum matches.
+    count = samples[('skytpu_lb_request_duration_seconds_count',
+                     '{replica="0",service="svc"}')]
+    assert ordered[-1][1] == count == 6
+    total = samples[('skytpu_lb_request_duration_seconds_sum',
+                     '{replica="0",service="svc"}')]
+    assert total == pytest.approx(0.003 + 0.02 + 0.02 + 0.4 + 7.0 + 1e9)
+
+
+def test_label_values_escaped():
+    metrics.inc_counter('skytpu_requests_total',
+                        name='we"ird\\na\nme', status='x')
+    out = metrics.render()
+    assert r'name="we\"ird\\na\nme"' in out
+    # The escaped line must still parse as a single sample line.
+    assert _parse_exposition(out)
+
+
+def test_histogram_unknown_family_uses_default_buckets():
+    metrics.observe_hist('skytpu_adhoc_seconds', 0.2)
+    text = metrics.render()
+    assert 'skytpu_adhoc_seconds_bucket{le="+Inf"} 1' in text
+    n_buckets = text.count('skytpu_adhoc_seconds_bucket')
+    assert n_buckets == len(metrics.DEFAULT_BUCKETS) + 1
+
+
+# ----- registry hygiene (CI gate) --------------------------------------------
+_CALL_RE = re.compile(
+    r"\b(inc_counter|set_gauge|add_gauge|remove_gauge|observe_hist"
+    r"|observe)\(\s*'([a-z0-9_]+)'", re.S)
+
+
+def test_every_exported_family_has_help_and_legal_name():
+    """Walk every metric call site in the package: each family must have
+    a _HELP entry, a legal Prometheus name, and the unit-suffix
+    conventions for its kind (counters end _total, histograms/summaries
+    carry a unit)."""
+    pkg_root = pathlib.Path(metrics.__file__).resolve().parents[1]
+    families = {}   # name -> set of instrument kinds
+    for path in pkg_root.rglob('*.py'):
+        for kind, name in _CALL_RE.findall(path.read_text()):
+            families.setdefault(name, set()).add(kind)
+    assert len(families) >= 15, sorted(families)
+    help_map = metrics.help_registry()
+    for name, kinds in sorted(families.items()):
+        assert re.fullmatch(r'[a-z_][a-z0-9_]*', name), name
+        assert name.startswith('skytpu_'), name
+        assert name in help_map, f'{name} lacks a _HELP entry'
+        if 'inc_counter' in kinds:
+            assert name.endswith('_total'), \
+                f'counter {name} must end _total'
+        if kinds & {'observe', 'observe_hist'}:
+            assert name.endswith(('_seconds', '_bytes')), \
+                f'distribution {name} must carry a unit suffix'
+        if kinds & {'set_gauge', 'add_gauge'}:
+            assert not name.endswith('_total'), \
+                f'gauge {name} must not end _total'
+    # Every declared histogram bucket set belongs to a known family and
+    # is strictly increasing.
+    for name, bounds in metrics._BUCKETS.items():
+        assert name in help_map, name
+        assert list(bounds) == sorted(set(bounds)), name
+
+
+# ----- k8s quantity parsing ---------------------------------------------------
+def test_parse_cpu_edge_cases():
+    from skypilot_tpu.metrics_utils import _parse_cpu
+    assert _parse_cpu('250m') == 250.0
+    assert _parse_cpu('2') == 2000.0
+    assert _parse_cpu('500000n') == 0.5
+    assert _parse_cpu('1500u') == 1.5
+    assert _parse_cpu('') == 0.0
+    assert _parse_cpu('   ') == 0.0
+    assert _parse_cpu('garbage') == 0.0
+    assert _parse_cpu('12xm') == 0.0
+    assert _parse_cpu(None) == 0.0
+    assert _parse_cpu(3) == 3000.0
+
+
+def test_parse_mem_edge_cases():
+    from skypilot_tpu.metrics_utils import _parse_mem
+    assert _parse_mem('1Ki') == 1024.0
+    assert _parse_mem('2Mi') == 2 * 2**20
+    assert _parse_mem('3Gi') == 3 * 2**30
+    assert _parse_mem('1.5Ti') == 1.5 * 2**40
+    assert _parse_mem('1K') == 1e3
+    assert _parse_mem('128') == 128.0
+    assert _parse_mem('1e3') == 1000.0
+    assert _parse_mem('128974848000m') == pytest.approx(128974848.0)
+    assert _parse_mem('') == 0.0
+    assert _parse_mem('junk') == 0.0
+    assert _parse_mem('10Xi') == 0.0     # unknown suffix: 0, not 10 bytes
+    assert _parse_mem('-5') == 0.0
+    assert _parse_mem(None) == 0.0
+
+
+# ----- engine instrumentation -------------------------------------------------
+class _CountingNumpy:
+    """numpy shim that counts asarray() calls — the engine's one
+    device->host sync per step goes through np.asarray."""
+
+    def __init__(self, real):
+        self._real = real
+        self.asarray_calls = 0
+
+    def __getattr__(self, name):
+        return getattr(self._real, name)
+
+    def asarray(self, *args, **kwargs):
+        self.asarray_calls += 1
+        return self._real.asarray(*args, **kwargs)
+
+
+@pytest.fixture(scope='module')
+def tiny_engine_model():
+    import jax
+    from skypilot_tpu.models.llama import LLAMA_CONFIGS, Llama, init_params
+    model = Llama(LLAMA_CONFIGS['tiny'])
+    params = init_params(model, jax.random.PRNGKey(0))['params']
+    return model, params
+
+
+def test_engine_metrics_recorded_without_extra_syncs(tiny_engine_model,
+                                                     monkeypatch):
+    """TTFT/ITL histograms + token counters + occupancy gauges appear,
+    and instrumentation adds ZERO device syncs: np.asarray is called
+    exactly once per step that had active slots."""
+    import numpy as real_np
+    from skypilot_tpu.inference import engine as engine_mod
+    counting = _CountingNumpy(real_np)
+    monkeypatch.setattr(engine_mod, 'np', counting)
+    model, params = tiny_engine_model
+    engine = engine_mod.DecodeEngine(
+        model, params,
+        engine_mod.EngineConfig(n_slots=2, prefill_buckets=(8,)))
+    req = engine.submit([1, 2, 3], 6)
+    active_steps = 0
+    while req.finished_at is None:
+        if engine.step() > 0:
+            active_steps += 1
+    engine.step()        # idle step: occupancy gauges observe the drain
+    assert req.tokens()                      # finished, tokens flowed
+    assert counting.asarray_calls == active_steps
+    samples = _parse_exposition(metrics.render())
+    get = lambda name: [v for (n, _), v in samples.items() if n == name]
+    assert get('skytpu_engine_ttft_seconds_count') == [1]
+    assert get('skytpu_engine_inter_token_seconds_count') == [1]
+    assert sum(get('skytpu_engine_prefill_tokens_total')) == 3
+    assert sum(get('skytpu_engine_decode_tokens_total')) == 6
+    assert get('skytpu_engine_requests_total') == [1]
+    assert get('skytpu_engine_queue_depth') == [0]
+    assert get('skytpu_engine_active_slots') == [0]  # drained at finish
+
+
+# ----- LB federation e2e ------------------------------------------------------
+def _free_port():
+    s = socket.socket()
+    s.bind(('127.0.0.1', 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _run_app_on_thread(app):
+    """Serve an aiohttp app on its own thread; -> (port, stop_fn)."""
+    from aiohttp import web
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+    state = {}
+
+    def run():
+        asyncio.set_event_loop(loop)
+
+        async def start():
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, '127.0.0.1', 0)
+            await site.start()
+            state['port'] = site._server.sockets[0].getsockname()[1]
+            state['runner'] = runner
+
+        loop.run_until_complete(start())
+        started.set()
+        loop.run_forever()
+
+    th = threading.Thread(target=run, daemon=True)
+    th.start()
+    assert started.wait(10)
+
+    def stop():
+        loop.call_soon_threadsafe(loop.stop)
+        th.join(timeout=5)
+
+    return state['port'], stop
+
+
+def _get(url, timeout=5.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, dict(resp.headers), resp.read().decode()
+
+
+def test_lb_federates_engine_metrics_per_replica(tiny_engine_model):
+    """End-to-end acceptance path: engine TTFT/inter-token histograms
+    and occupancy gauges are scrapeable via the LOAD BALANCER's
+    /metrics, relabeled replica="<id>"."""
+    from skypilot_tpu.inference.engine import DecodeEngine, EngineConfig
+    from skypilot_tpu.inference.server import build_app
+    from skypilot_tpu.serve.load_balancer import LoadBalancer
+    from skypilot_tpu.serve.load_balancing_policies import RoundRobinPolicy
+    model, params = tiny_engine_model
+    engine = DecodeEngine(model, params,
+                          EngineConfig(n_slots=2, prefill_buckets=(8,)))
+    req = engine.submit([4, 5, 6], 5)
+    while req.finished_at is None:
+        engine.step()
+    req.tokens()
+    replica_port, stop_replica = _run_app_on_thread(build_app(engine))
+    replica_url = f'http://127.0.0.1:{replica_port}'
+    lb = LoadBalancer(
+        'fed-svc', _free_port(), RoundRobinPolicy(),
+        ready_urls_fn=lambda: [replica_url],
+        ready_replicas_fn=lambda: [(7, replica_url)])
+    lb.start()
+    try:
+        # A proxied request first, so per-replica LB series exist too.
+        status, _, _ = _get(lb.endpoint + '/health')
+        assert status == 200
+        status, _, text = _get(lb.endpoint + '/metrics')
+        assert status == 200
+        # Engine histograms re-exported under the replica label.
+        assert re.search(
+            r'skytpu_engine_ttft_seconds_bucket\{[^}]*replica="7"[^}]*\} '
+            r'[0-9.]+', text), text[:2000]
+        assert re.search(
+            r'skytpu_engine_inter_token_seconds_count\{replica="7"\} 1',
+            text)
+        assert re.search(
+            r'skytpu_engine_batch_occupancy_ratio\{replica="7"\}', text)
+        # The LB's own per-replica series (not federated; labeled at
+        # record time).
+        assert re.search(
+            r'skytpu_lb_requests_total\{code="200",replica="7",'
+            r'service="fed-svc"\} 1', text)
+        assert re.search(
+            r'skytpu_lb_request_duration_seconds_bucket\{[^}]*'
+            r'replica="7"', text)
+        # Federated output stays well-formed: one TYPE line per family.
+        for family in ('skytpu_engine_ttft_seconds',
+                       'skytpu_lb_requests_total'):
+            assert text.count(f'# TYPE {family} ') == 1
+    finally:
+        lb.stop()
+        stop_replica()
+
+
+def test_lb_no_ready_replicas_503_retry_after():
+    from skypilot_tpu.serve.load_balancer import LoadBalancer
+    from skypilot_tpu.serve.load_balancing_policies import RoundRobinPolicy
+    lb = LoadBalancer('empty-svc', _free_port(), RoundRobinPolicy(),
+                      ready_urls_fn=lambda: [],
+                      ready_replicas_fn=lambda: [])
+    lb.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(lb.endpoint + '/anything')
+        assert err.value.code == 503
+        assert err.value.headers['Retry-After'] is not None
+        out = metrics.render()
+        assert ('skytpu_lb_no_ready_replicas_total{service="empty-svc"} '
+                '1.0') in out
+        # The LB's own /metrics still answers when nothing is ready.
+        status, _, text = _get(lb.endpoint + '/metrics')
+        assert status == 200
+        assert 'skytpu_lb_no_ready_replicas_total' in text
+    finally:
+        lb.stop()
+
+
+# ----- timeline thread ids ----------------------------------------------------
+def test_timeline_thread_ids_stable_and_distinct(monkeypatch, tmp_path):
+    monkeypatch.setenv('SKYTPU_TIMELINE_FILE', str(tmp_path / 't.json'))
+    barrier = threading.Barrier(3)
+
+    def work():
+        barrier.wait(timeout=10)
+        with timeline.Event('worker'):
+            pass
+        with timeline.Event('worker-again'):
+            pass
+
+    threads = [threading.Thread(target=work) for _ in range(2)]
+    for t in threads:
+        t.start()
+    barrier.wait(timeout=10)
+    for t in threads:
+        t.join()
+    with timeline.Event('main'):
+        pass
+    data = json.loads(open(timeline.dump()).read())
+    tids_by_name = {}
+    for e in data['traceEvents']:
+        tids_by_name.setdefault(e['name'], set()).add(e['tid'])
+    # Each thread keeps ONE stable tid across all its events...
+    assert len(tids_by_name['worker']) == 2
+    assert tids_by_name['worker'] == tids_by_name['worker-again']
+    # ...and ids are small sequential ints (no modulus aliasing).
+    all_tids = set().union(*tids_by_name.values())
+    assert len(all_tids) == 3
+    assert all_tids <= set(range(len(all_tids)))

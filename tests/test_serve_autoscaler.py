@@ -226,3 +226,71 @@ def test_ondemand_fallback_selection(tmp_home):
     placer.handle_preemption('us-east5-a')
     placer.handle_preemption('us-east5-b')
     assert mgr._next_is_spot() is False
+
+
+def test_request_rate_autoscaler_counter_source_matches_trace():
+    """evaluate_counter: QPS from the LB's monotonic request counter
+    (the skytpu_lb_requests_total source) drives the same decisions as
+    the timestamp-trace path."""
+    a = RequestRateAutoscaler(_spec(), decision_interval_seconds=1.0,
+                              qps_window_seconds=10.0)
+    now = 1000.0
+    # 6 requests/second sampled once per second over a full window:
+    # 6 qps / 2 per-replica -> desired 3; two consecutive overloaded
+    # ticks commit the upscale (hysteresis identical to evaluate()).
+    total = 0
+    for i in range(11):
+        total = 6 * i
+        d = a.evaluate_counter(total, 1, now + i)
+    assert d.target_num_replicas == 3
+    assert d.delta == 2
+    # Traffic stops: the counter plateaus, QPS decays to 0 as the
+    # baseline sample ages out, and downscale engages after its delay.
+    for i in range(11, 26):
+        d = a.evaluate_counter(total, 3, now + i)
+    assert d.target_num_replicas == 1
+
+
+def test_counter_autoscaler_needs_two_samples():
+    a = RequestRateAutoscaler(_spec(), decision_interval_seconds=1.0,
+                              qps_window_seconds=10.0)
+    # One sample gives no rate: hold at min.
+    d = a.evaluate_counter(1000, 1, 500.0)
+    assert d.target_num_replicas == 1
+    assert a.current_qps_from_counter() == 0.0
+
+
+def test_fixed_autoscaler_counter_path_ignores_load():
+    spec = ServiceSpec.from_yaml_config(
+        {'readiness_probe': '/', 'replicas': 2})
+    a = Autoscaler.make(spec, decision_interval_seconds=1.0)
+    assert a.evaluate_counter(10_000, 2, 100.0).delta == 0
+    assert a.evaluate_counter(99_999, 0, 101.0).delta == 2
+
+
+def test_autoscaler_adopt_history_across_serve_update():
+    """`serve update` rebuilds the autoscaler; the replacement must not
+    scale a loaded service down to min_replicas nor read 0 QPS while
+    its window refills."""
+    a = RequestRateAutoscaler(_spec(), decision_interval_seconds=1.0,
+                              qps_window_seconds=10.0)
+    now = 1000.0
+    for i in range(11):
+        d = a.evaluate_counter(6 * i, 3, now + i)
+    assert d.target_num_replicas == 3
+    new = RequestRateAutoscaler(_spec(), decision_interval_seconds=1.0,
+                                qps_window_seconds=10.0)
+    new.adopt_history(a)
+    d = new.evaluate_counter(66, 3, now + 11)
+    assert d.target_num_replicas == 3 and d.delta == 0
+    # Target clamps to the updated spec's bounds.
+    shrunk = RequestRateAutoscaler(_spec(max_replicas=2),
+                                   decision_interval_seconds=1.0,
+                                   qps_window_seconds=10.0)
+    shrunk.adopt_history(a)
+    assert shrunk.target_num_replicas == 2
+    # The fixed policy pins to its configured count: adoption is a no-op.
+    fixed = Autoscaler.make(ServiceSpec.from_yaml_config(
+        {'readiness_probe': '/', 'replicas': 2}), 1.0)
+    fixed.adopt_history(a)
+    assert fixed.evaluate_counter(999, 2, now).target_num_replicas == 2
