@@ -253,8 +253,93 @@ def bench_serve(on_tpu: bool) -> dict:
     }
 
 
+def bench_launch() -> dict:
+    """Control-plane overhead: launch -> agent READY -> rank-0 start.
+
+    Hermetic: provisions a one-node cluster on the `local` cloud (the
+    same agent bootstrap path every cloud uses) under a throwaway $HOME,
+    so the bench never touches real state or credentials.  Three
+    stamps:
+      - agent_ready_s: execution.launch() return — optimizer +
+        provision + agent bootstrap; launch() returns only after the
+        agent answered its readiness probe and rank 0 was submitted.
+      - rank0_start_s: job-queue `started_at` minus launch() return —
+        scheduler latency from submission to the rank-0 process
+        starting.
+      - launch_overhead_s: the whole path, launch() call to rank-0
+        start.  This is the per-replica scale-up cost the serve
+        autoscaler pays before a new replica takes traffic.
+    """
+    import os
+    import shutil
+    import tempfile
+
+    keys = ('HOME', 'SKYTPU_GLOBAL_CONFIG', 'SKYTPU_PROJECT_CONFIG',
+            'SKYTPU_ENABLED_CLOUDS')
+    saved = {k: os.environ.get(k) for k in keys}
+    home = tempfile.mkdtemp(prefix='skytpu-bench-home-')
+    os.environ['HOME'] = home
+    os.environ['SKYTPU_GLOBAL_CONFIG'] = os.path.join(
+        home, '.skytpu', 'config.yaml')
+    os.environ['SKYTPU_PROJECT_CONFIG'] = os.path.join(home, '.skytpu.yaml')
+    os.environ['SKYTPU_ENABLED_CLOUDS'] = 'local'
+    cluster = 'bench-launch'
+    launched = False
+    try:
+        from skypilot_tpu import core, execution
+        from skypilot_tpu.resources import Resources
+        from skypilot_tpu.task import Task
+
+        task = Task('bench-launch', run='true')
+        task.set_resources(Resources.from_yaml_config({'infra': 'local'}))
+        wall0 = time.time()
+        t0 = time.perf_counter()
+        job_id, _ = execution.launch(task, cluster, detach_run=True,
+                                     quiet_optimizer=True)
+        launched = True
+        agent_ready_s = time.perf_counter() - t0
+        started_at = None
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            rec = next((j for j in core.queue(cluster)
+                        if j['job_id'] == job_id), None)
+            if rec is not None and rec.get('started_at'):
+                started_at = float(rec['started_at'])
+                break
+            time.sleep(0.1)
+        if started_at is None:
+            return {'error': 'rank-0 never started within 60s',
+                    'agent_ready_s': round(agent_ready_s, 3)}
+        return {
+            'launch_overhead_s': round(started_at - wall0, 3),
+            'agent_ready_s': round(agent_ready_s, 3),
+            'rank0_start_s': round(started_at - (wall0 + agent_ready_s),
+                                   3),
+        }
+    except Exception as e:  # pylint: disable=broad-except
+        return {'error': f'{type(e).__name__}: {e}'}
+    finally:
+        # Teardown BEFORE the env restore / rmtree: the agent spawned by
+        # launch() must be stopped under the same $HOME it was started
+        # with, and must never outlive its deleted state directory.
+        if launched:
+            try:
+                core.down(cluster)
+            except Exception:  # pylint: disable=broad-except
+                pass
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        shutil.rmtree(home, ignore_errors=True)
+
+
 def main() -> None:
     on_tpu = jax.default_backend() == 'tpu'
+    # Control-plane first: hermetic, no device state, and the number is
+    # honest-cold (no JAX executables or page cache warmed by training).
+    launch = bench_launch()
     train = bench_train(on_tpu)
     # Long-context differentiator: same model/token budget at 2x the
     # sequence length (flash fwd+bwd + per-block remat keep attention
@@ -277,6 +362,7 @@ def main() -> None:
             'train': train,
             'train_long_context_8k': train_8k,
             'serve': serve,
+            'launch': launch,
             'baseline': 'reference Llama-3-8B PyTorch/XLA FSDP v6e-8 '
                         '= 2.225% MFU (examples/tpu/v6e/README.md:34-48)',
         },

@@ -32,6 +32,20 @@ compile once per bucket.  Slot safety relies on the model cache's
 invariant (models/llama.py _decode_attend): attention masks k_pos >
 q_pos, and inserts overwrite a slot's whole cache, so a reused slot never
 leaks its previous request's KV.
+
+Tensor parallelism (13B-70B serving): pass `EngineConfig(mesh=...)`
+(parallel/mesh.py build_serve_mesh) and every program above runs
+mesh-sharded — params via the model's logical-axis annotations
+(attention heads / MLP hidden / vocab split over the tensor axis,
+everything else replicated), the per-layer KV cache
+[n_slots, n_kv_heads, max_seq_len, head_dim] over its kv-heads dim, and
+the jitted prefill_insert/decode programs pinned to those NamedShardings
+so XLA inserts the one all-reduce per projection block that megatron-
+style TP implies.  Engine state that the host reads (last tokens,
+lengths, the [T+1, n_slots] output) stays replicated: the host loop is
+IDENTICAL under a mesh — same one sync per step, same pipelining, same
+slot bookkeeping.  `mesh=None` is the exact single-device path
+(including the TPU layout pinning below), byte-for-byte unchanged.
 """
 from __future__ import annotations
 
@@ -64,6 +78,11 @@ class EngineConfig:
     eos_id: Optional[int] = None       # None: never stop on a token
     temperature: float = 0.0           # 0 => greedy
     seed: int = 0
+    # Tensor parallelism: a jax.sharding.Mesh whose `tensor_axis` names
+    # the axis attention heads / MLP hidden shard over (build one with
+    # parallel/mesh.py build_serve_mesh).  None = single-device engine.
+    mesh: Optional[Any] = None
+    tensor_axis: str = 'tensor'
 
 
 @dataclasses.dataclass
@@ -139,9 +158,16 @@ class DecodeEngine:
         self.error: Optional[BaseException] = None
         self._fmt_params = None
         self._prefill_compiled: Dict[tuple, Any] = {}
+        # Mesh-sharded serving state (None on the single-device path).
+        self._mesh = config.mesh
+        self._param_shardings = None
+        self._cache_shardings = None
+        self._repl = None
+        if self._mesh is not None:
+            self._setup_mesh()
         self._build_fns()
         self._init_cache()
-        if jax.default_backend() == 'tpu':
+        if jax.default_backend() == 'tpu' and self._mesh is None:
             try:
                 self._optimize_layouts()
             except Exception:  # pylint: disable=broad-except
@@ -155,6 +181,63 @@ class DecodeEngine:
     @property
     def healthy(self) -> bool:
         return self.error is None
+
+    # ----- mesh setup --------------------------------------------------------
+    def _setup_mesh(self):
+        """Commit engine state to fixed NamedShardings.
+
+        Params shard per the model's logical axes (serving_shardings),
+        the KV cache over its kv-heads dim, and everything the host
+        syncs (last tokens / lengths / decode output) is replicated.
+        Committing at init means every later dispatch hits the same
+        compiled programs — sharding never recompiles mid-traffic.
+        """
+        import flax.linen as nn
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from skypilot_tpu.inference.weights import serving_shardings
+        from skypilot_tpu.parallel import mesh as mesh_lib
+
+        mesh, axis = self._mesh, self.cfg.tensor_axis
+        mcfg = self.model.cfg
+        mesh_lib.validate_tensor_parallel(
+            int(mesh.shape.get(axis, 1)), n_heads=mcfg.n_heads,
+            n_kv_heads=getattr(mcfg, 'n_kv_heads', None))
+        if getattr(self.model, 'mesh', None) is None:
+            # The model needs the mesh too (activation constraints, the
+            # one-hot embed that keeps a vocab-sharded table gather-free).
+            self.model = self.model.clone(mesh=mesh)
+        self._repl = NamedSharding(mesh, P())
+        self._param_shardings = serving_shardings(self.model, mesh)
+        # Unbox first: flax logical-axis metadata boxes carry init-time
+        # sharding hints the engine has now consumed; apply() is
+        # box-agnostic and device_put needs tree alignment with the
+        # (unboxed) sharding tree.
+        self.params = jax.device_put(nn.meta.unbox(self.params),
+                                     self._param_shardings)
+        # Per-layer KV cache [n_slots, n_kv_heads, max_len, head_dim]:
+        # shard over kv heads (validated divisible above).  Computed from
+        # an abstract cache trace so MoE/model variants with extra cache
+        # leaves or head layouts still map correctly.
+        kv = NamedSharding(mesh, P(None, axis))
+
+        def _kv_or_repl(leaf):
+            n_kv = leaf.shape[1] if len(leaf.shape) > 1 else 0
+            tp = int(mesh.shape.get(axis, 1))
+            return kv if n_kv and n_kv % tp == 0 else self._repl
+
+        cache_abs = jax.eval_shape(self._make_cache, self.params)
+        self._cache_shardings = jax.tree.map(_kv_or_repl, cache_abs)
+
+    def _make_cache(self, params):
+        """Trace a dummy decode batch; returns the big per-layer cache."""
+        n = self.cfg.n_slots
+        tokens = jnp.zeros((n, 1), jnp.int32)
+        positions = jnp.zeros((n, 1), jnp.int32)
+        _, cache = self.model.apply(
+            {'params': params}, tokens, positions=positions,
+            decode=True, mutable=['cache'])
+        return cache['cache']
 
     # ----- jitted compute ----------------------------------------------------
     def _build_fns(self):
@@ -226,22 +309,45 @@ class DecodeEngine:
 
         self._prefill_raw = prefill_insert
         self._decode_raw = decode
-        self._prefill_insert = jax.jit(prefill_insert,
-                                       donate_argnums=(1, 2, 3))
-        self._decode = jax.jit(decode, donate_argnums=(1, 2, 3))
+        if self._mesh is None:
+            self._prefill_insert = jax.jit(prefill_insert,
+                                           donate_argnums=(1, 2, 3))
+            self._decode = jax.jit(decode, donate_argnums=(1, 2, 3))
+        else:
+            # Pin every program to the engine's committed shardings:
+            # donated state (cache/last/lens) comes back in the same
+            # placement it went in, so call k+1 reuses call k's cache
+            # entry — the zero-recompile invariant, now sharded.  The
+            # host-fetched output and all host-built inputs (tokens,
+            # lengths, slots, rng) are replicated.
+            p_sh, c_sh, r = (self._param_shardings, self._cache_shardings,
+                             self._repl)
+            self._prefill_insert = jax.jit(
+                prefill_insert, donate_argnums=(1, 2, 3),
+                in_shardings=(p_sh, c_sh, r, r, r, r, r, r, r),
+                out_shardings=(c_sh, r, r))
+            self._decode = jax.jit(
+                decode, donate_argnums=(1, 2, 3),
+                in_shardings=(p_sh, c_sh, r, r, r),
+                out_shardings=(r, c_sh, r, r))
 
     def _init_cache(self):
-        """Materialize the big cache by tracing a dummy decode batch."""
+        """Materialize the big cache by tracing a dummy decode batch.
+        Under a mesh it is created ALREADY sharded (jit out_shardings) —
+        at no point does a full cache exist on one device."""
         n = self.cfg.n_slots
-        tokens = jnp.zeros((n, 1), jnp.int32)
-        positions = jnp.zeros((n, 1), jnp.int32)
-        _, cache = self.model.apply(
-            {'params': self.params}, tokens, positions=positions,
-            decode=True, mutable=['cache'])
-        self._cache = cache['cache']
-        # Device-resident engine state: synced host-ward once per step.
-        self._last_d = jnp.zeros((n,), jnp.int32)
-        self._lens_d = jnp.zeros((n,), jnp.int32)
+        if self._mesh is None:
+            self._cache = self._make_cache(self.params)
+            self._last_d = jnp.zeros((n,), jnp.int32)
+            self._lens_d = jnp.zeros((n,), jnp.int32)
+            return
+        self._cache = jax.jit(
+            self._make_cache,
+            out_shardings=self._cache_shardings)(self.params)
+        self._last_d = jax.device_put(jnp.zeros((n,), jnp.int32),
+                                      self._repl)
+        self._lens_d = jax.device_put(jnp.zeros((n,), jnp.int32),
+                                      self._repl)
 
     def _optimize_layouts(self):
         """TPU: pre-lay-out the weights the way the decode loop wants.
@@ -372,6 +478,13 @@ class DecodeEngine:
             if self._fmt_params is not None:
                 import jax as _jax
                 params = _jax.device_put(params, self._fmt_params)
+            elif self._param_shardings is not None:
+                # Mesh path: land the new tree (host numpy from an RL
+                # learner, or another placement) in the SAME committed
+                # shardings — the compiled programs keep hitting cache.
+                import flax.linen as nn
+                params = jax.device_put(nn.meta.unbox(params),
+                                        self._param_shardings)
             self.params = params
 
     def prewarm(self) -> None:
@@ -381,7 +494,18 @@ class DecodeEngine:
         |buckets| x (log2(n_slots)+1).  Without this, the first burst
         that hits a new shape stalls the whole decode batch behind a
         multi-second XLA compile — a mid-traffic TTFT/TPOT spike.
+
+        Mesh path: the sharded executables live in the ordinary jit
+        cache, so prewarming EXECUTES one dummy dispatch per admission
+        shape plus one decode call (valid=0 rows into slot 0 — the
+        engine is idle, nothing reads the scribbled state, and the next
+        real admission overwrites it).  This matters most exactly here:
+        a 70B-class sharded program is the longest compile in the
+        system, and must not be paid under live traffic.
         """
+        if self._mesh is not None:
+            self._prewarm_mesh()
+            return
         if self._fmt_params is None:
             return
         # Include the first power of two >= n_slots: _admit_group pads to
@@ -389,16 +513,42 @@ class DecodeEngine:
         # itself one (n_slots=6, burst of 5 -> pad 8) — without it the
         # first such burst hits the mid-traffic compile stall prewarm
         # exists to prevent.
-        n = 1
-        sizes = []
+        for bucket in self.cfg.prefill_buckets:
+            for size in self._prewarm_sizes():
+                self._prefill_for(bucket, size)
+
+    def _prewarm_sizes(self):
+        """Padded admission-group row counts: powers of two up to and
+        including the first one >= n_slots (see prewarm)."""
+        n, sizes = 1, []
         while True:
             sizes.append(n)
             if n >= self.cfg.n_slots:
                 break
             n *= 2
+        return sizes
+
+    def _prewarm_mesh(self):
+        """Compile every sharded shape by executing dummy dispatches.
+
+        Must run before start() (single-threaded, engine idle).  All
+        rows carry valid=0 and target slot 0; lengths=1 keeps the
+        last-token gather in range.  Slot 0's cache/last/lens end up
+        scribbled — harmless, an insert overwrites a slot wholesale and
+        no slot is active to read them.
+        """
         for bucket in self.cfg.prefill_buckets:
-            for size in sizes:
-                self._prefill_for(bucket, size)
+            for size in self._prewarm_sizes():
+                tokens = jnp.zeros((size, bucket), jnp.int32)
+                ones = jnp.ones((size,), jnp.int32)
+                zeros = jnp.zeros((size,), jnp.int32)
+                (self._cache, self._last_d,
+                 self._lens_d) = self._prefill_insert(
+                     self.params, self._cache, self._last_d, self._lens_d,
+                     tokens, ones, zeros, zeros, self._next_rng())
+        _, self._cache, self._last_d, self._lens_d = self._decode(
+            self.params, self._cache, self._last_d, self._lens_d,
+            self._next_rng())
 
     def start(self):
         self._thread = threading.Thread(target=self._loop,

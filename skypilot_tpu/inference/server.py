@@ -103,6 +103,14 @@ def main() -> None:
     parser.add_argument('--n-slots', type=int, default=8)
     parser.add_argument('--max-seq-len', type=int, default=1024)
     parser.add_argument(
+        '--tensor', type=int,
+        default=int(os.environ.get('SKYTPU_SERVE_TENSOR', '1')),
+        help='tensor-parallel degree: shard weights/KV cache over this '
+        'many chips (must divide the model\'s head counts; 1 = '
+        'single-chip engine).  Serve specs set it via '
+        'service.tensor_parallel, which arrives here as '
+        'SKYTPU_SERVE_TENSOR.')
+    parser.add_argument(
         '--checkpoint', default=None,
         help='orbax checkpoint dir (local path or gs://bucket/prefix); '
         'restores trained params instead of random init')
@@ -121,23 +129,34 @@ def main() -> None:
     if args.param_dtype:
         cfg = dataclasses.replace(
             cfg, param_dtype=getattr(jax.numpy, args.param_dtype))
-    model = Llama(cfg)
+    mesh = None
+    if args.tensor > 1:
+        from skypilot_tpu.parallel.mesh import build_serve_mesh
+        mesh = build_serve_mesh(args.tensor, n_heads=cfg.n_heads,
+                                n_kv_heads=cfg.n_kv_heads)
+    model = Llama(cfg, mesh)
     if args.checkpoint:
-        from skypilot_tpu.inference.weights import load_serving_params
+        from skypilot_tpu.inference.weights import (load_serving_params,
+                                                    serving_shardings)
+        shardings = (serving_shardings(model, mesh)
+                     if mesh is not None else None)
+        # Under a mesh each leaf lands directly in its sharded placement
+        # — the full tree never exists on one chip.
         params = load_serving_params(args.checkpoint,
-                                     dtype=cfg.param_dtype)
+                                     dtype=cfg.param_dtype,
+                                     shardings=shardings)
     else:
         logger.warning('no --checkpoint given: serving RANDOM-INIT params '
                        '(demo mode)')
         params = init_params(model, jax.random.PRNGKey(0))['params']
     engine = DecodeEngine(model, params,
-                          EngineConfig(n_slots=args.n_slots))
+                          EngineConfig(n_slots=args.n_slots, mesh=mesh))
     # Compile every prefill shape before taking traffic — a mid-burst
     # XLA compile would stall the whole decode batch for seconds.
     engine.prewarm()
     engine.start()
     logger.info(f'serving {args.model} on :{args.port} '
-                f'({args.n_slots} slots, '
+                f'({args.n_slots} slots, tensor={args.tensor}, '
                 f'checkpoint={args.checkpoint or "random-init"})')
     web.run_app(build_app(engine), port=args.port, print=None)
 

@@ -50,8 +50,53 @@ def _cleanup_fetched(path: str, local: str) -> None:
         shutil.rmtree(local, ignore_errors=True)
 
 
+def serving_shardings(model, mesh, rules: Optional[Any] = None) -> Any:
+    """Per-leaf NamedShardings for a serve replica's param tree.
+
+    Derived from the model's logical-axis annotations exactly like the
+    trainer does (`nn.logical_to_mesh_sharding` over an abstract init),
+    so train and serve agree on what shards where; the serving defaults
+    put attention heads / MLP hidden / vocab on the `tensor` axis and
+    replicate the rest (parallel/sharding.py DEFAULT_RULES with every
+    non-tensor axis sized 1 on a serve mesh).  Any dimension the mesh
+    does not divide evenly falls back to replicated for that axis — a
+    vocab or ffn size that does not split cleanly must not refuse to
+    serve.  Returns an UNBOXED tree aligned with the raw param arrays.
+    """
+    import math
+
+    import flax.linen as nn
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from skypilot_tpu.parallel import sharding as sharding_lib
+
+    rules = list(rules or sharding_lib.DEFAULT_RULES)
+    abstract = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32)))
+    specs = nn.get_partition_spec(abstract)['params']
+    shardings = nn.meta.unbox(
+        nn.logical_to_mesh_sharding(specs, mesh, rules))
+    leaves_abs = nn.meta.unbox(abstract['params'])
+
+    def _guard(sharding, leaf):
+        spec = sharding.spec
+        kept = []
+        for i, axes in enumerate(spec):
+            if axes is None:
+                kept.append(None)
+                continue
+            names = (axes,) if isinstance(axes, str) else tuple(axes)
+            size = math.prod(mesh.shape[a] for a in names)
+            kept.append(axes if leaf.shape[i] % size == 0 else None)
+        return NamedSharding(mesh, PartitionSpec(*kept))
+
+    return jax.tree.map(_guard, shardings, leaves_abs)
+
+
 def load_serving_params(path: str, step: Optional[int] = None,
-                        dtype: Any = None) -> Any:
+                        dtype: Any = None, shardings: Any = None) -> Any:
     """Restore model params from an orbax checkpoint directory.
 
     Accepts either a params-only checkpoint or a full TrainState
@@ -66,6 +111,13 @@ def load_serving_params(path: str, step: Optional[int] = None,
     serve single-chip) — so every leaf is restored to host numpy via
     per-leaf RestoreArgs and the params are then device_put, optionally
     cast to `dtype` (pass jnp.bfloat16 to halve HBM for big models).
+
+    `shardings` (a tree of NamedShardings matching the param tree, e.g.
+    from `serving_shardings`) places each leaf DIRECTLY onto its mesh
+    layout as it is restored: a tensor-parallel replica never
+    materializes the full tree on any single device — the property that
+    lets a 70B checkpoint load onto chips that individually cannot hold
+    it.
     """
     import numpy as np
     import orbax.checkpoint as ocp
@@ -83,7 +135,11 @@ def load_serving_params(path: str, step: Optional[int] = None,
         logger.info(f'restoring checkpoint step {step} from {path}')
         step_dir = os.path.join(local, str(step), 'default')
         ckptr = ocp.PyTreeCheckpointer()
-        meta = ckptr.metadata(step_dir).item_metadata.tree
+        meta = ckptr.metadata(step_dir)
+        if hasattr(meta, 'item_metadata'):
+            # Newer orbax wraps the tree in CheckpointMetadata; older
+            # (<=0.7) returns the metadata tree directly.
+            meta = meta.item_metadata.tree
         is_leaf = lambda x: hasattr(x, 'dtype') and hasattr(x, 'shape')  # noqa: E731,E501
         restore_args = jax.tree.map(
             lambda _: ocp.RestoreArgs(restore_type=np.ndarray), meta,
@@ -97,10 +153,12 @@ def load_serving_params(path: str, step: Optional[int] = None,
     if isinstance(restored, dict) and 'params' in restored:
         restored = restored['params']
 
-    def _put(x):
+    def _put(x, sharding=None):
         if dtype is not None and jax.numpy.issubdtype(x.dtype,
                                                       jax.numpy.floating):
             x = x.astype(dtype)
-        return jax.device_put(x)
+        return jax.device_put(x, sharding)
 
+    if shardings is not None:
+        return jax.tree.map(_put, restored, shardings)
     return jax.tree.map(_put, restored)

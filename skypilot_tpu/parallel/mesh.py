@@ -122,6 +122,68 @@ def plan_mesh(n_devices: int,
     return plan
 
 
+def validate_tensor_parallel(tensor: int,
+                             n_heads: Optional[int] = None,
+                             n_kv_heads: Optional[int] = None) -> None:
+    """Reject a tensor degree the model's head layout cannot shard.
+
+    Tensor parallelism splits attention over heads: `tensor` must divide
+    `n_heads` (query heads) and, under GQA, `n_kv_heads` as well — the
+    per-layer KV cache shards over kv heads, and a non-dividing degree
+    would leave some chip with a fractional head.  (Replicating KV under
+    an over-wide degree is possible but silently wastes the HBM the user
+    went multi-chip to get; make them pick a degree that fits.)
+    """
+    if n_heads is not None and n_heads % tensor != 0:
+        raise ValueError(
+            f'tensor={tensor} does not divide n_heads={n_heads}; '
+            f'attention shards over query heads')
+    if n_kv_heads is not None and n_kv_heads % tensor != 0:
+        raise ValueError(
+            f'tensor={tensor} does not divide n_kv_heads={n_kv_heads} '
+            f'(GQA): the KV cache shards over kv heads — pick a tensor '
+            f'degree that divides both head counts')
+
+
+def plan_serve_mesh(n_devices: int,
+                    tensor: Optional[int] = None,
+                    n_heads: Optional[int] = None,
+                    n_kv_heads: Optional[int] = None) -> MeshPlan:
+    """Mesh plan for a SERVE replica: tensor parallelism only.
+
+    Unlike `plan_mesh` (training), leftover devices go to `data` (pure
+    replication for the decode batch) rather than fsdp, `tensor`
+    defaults to the whole device set (decode is bandwidth-bound — every
+    chip's HBM should hold a weight shard), and the `dcn` axis is NEVER
+    inherited from SKYTPU_NUM_SLICES: a serve replica is per-slice by
+    construction (the service load balancer, not DCN collectives,
+    spreads traffic across slices).
+    """
+    tensor = int(n_devices if tensor is None else tensor)
+    if tensor < 1 or n_devices % tensor != 0:
+        raise ValueError(
+            f'tensor={tensor} must be >= 1 and divide the serve '
+            f'replica\'s device count {n_devices}')
+    validate_tensor_parallel(tensor, n_heads=n_heads, n_kv_heads=n_kv_heads)
+    return MeshPlan(data=n_devices // tensor, tensor=tensor)
+
+
+def build_serve_mesh(tensor: int,
+                     n_heads: Optional[int] = None,
+                     n_kv_heads: Optional[int] = None,
+                     devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Mesh for a tensor-parallel serve engine over the first `tensor`
+    devices (jax.devices() order follows the ICI torus, so adjacent
+    chips land on the tensor axis — the axis that rides every matmul)."""
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) < tensor:
+        raise ValueError(
+            f'tensor={tensor} needs {tensor} devices, have {len(devices)}')
+    plan = plan_serve_mesh(tensor, tensor=tensor, n_heads=n_heads,
+                           n_kv_heads=n_kv_heads)
+    return build_mesh(plan, devices[:tensor])
+
+
 def build_mesh(plan: Optional[MeshPlan] = None,
                devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     """Construct the Mesh.  Device order is `jax.devices()` order, which on a

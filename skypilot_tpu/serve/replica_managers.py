@@ -40,6 +40,7 @@ _REPLACE_THRESHOLD = 12
 ENV_REPLICA_PORT = 'SKYTPU_SERVE_REPLICA_PORT'
 ENV_REPLICA_ID = 'SKYTPU_SERVE_REPLICA_ID'
 ENV_SERVICE_NAME = 'SKYTPU_SERVE_SERVICE_NAME'
+ENV_REPLICA_TENSOR = 'SKYTPU_SERVE_TENSOR'
 
 
 class ReplicaManager:
@@ -120,11 +121,16 @@ class ReplicaManager:
                       is_spot: bool) -> task_lib.Task:
         task = task_lib.Task.from_yaml_config(self.task.to_yaml_config())
         task.service = None  # the replica runs the workload, not a service
-        task.update_envs({
+        envs = {
             ENV_REPLICA_PORT: str(port),
             ENV_REPLICA_ID: str(replica_id),
             ENV_SERVICE_NAME: self.service_name,
-        })
+        }
+        if self.spec.tensor_parallel > 1:
+            # The inference server reads this as its --tensor default:
+            # the replica's engine shards over that many chips.
+            envs[ENV_REPLICA_TENSOR] = str(self.spec.tensor_parallel)
+        task.update_envs(envs)
         res = task.any_resources
         overrides = {}
         if res.use_spot and not is_spot:

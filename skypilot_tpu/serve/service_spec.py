@@ -36,6 +36,10 @@ class ServiceSpec:
     # Spot-replica policy (reference: autoscalers.py dynamic fallback).
     dynamic_ondemand_fallback: bool = False
     base_ondemand_fallback_replicas: int = 0
+    # Tensor-parallel degree for the replica's decode engine: the
+    # inference server shards weights/KV cache over this many chips
+    # (reaches the workload as SKYTPU_SERVE_TENSOR; 1 = single-chip).
+    tensor_parallel: int = 1
 
     @classmethod
     def from_yaml_config(cls, config: Dict[str, Any]) -> 'ServiceSpec':
@@ -57,12 +61,14 @@ class ServiceSpec:
             raise exceptions.InvalidTaskError(
                 'service: give either `replicas` (fixed) or '
                 '`replica_policy` (autoscaling), not both')
+        tensor_parallel = int(config.get('tensor_parallel', 1))
         if policy is None:
             n = int(fixed if fixed is not None else 1)
             return cls(readiness_probe=probe, min_replicas=n,
                        max_replicas=None, target_qps_per_replica=None,
                        load_balancing_policy=config.get(
-                           'load_balancing_policy', 'least_load'))
+                           'load_balancing_policy', 'least_load'),
+                       tensor_parallel=tensor_parallel)
         min_r = int(policy.get('min_replicas', 1))
         max_r = policy.get('max_replicas')
         target_qps = policy.get('target_qps_per_replica')
@@ -95,6 +101,7 @@ class ServiceSpec:
                 policy.get('dynamic_ondemand_fallback', False)),
             base_ondemand_fallback_replicas=int(
                 policy.get('base_ondemand_fallback_replicas', 0)),
+            tensor_parallel=tensor_parallel,
         )
 
     def to_yaml_config(self) -> Dict[str, Any]:
@@ -127,6 +134,8 @@ class ServiceSpec:
         else:
             out['replicas'] = self.min_replicas
         out['load_balancing_policy'] = self.load_balancing_policy
+        if self.tensor_parallel != 1:
+            out['tensor_parallel'] = self.tensor_parallel
         return out
 
     @property

@@ -89,6 +89,9 @@ _SERVICE_SCHEMA: Dict[str, Any] = {
         'load_balancing_policy': {
             'enum': ['round_robin', 'least_load', 'instance_aware']
         },
+        # Tensor-parallel degree for each replica's decode engine
+        # (plumbed to the workload as SKYTPU_SERVE_TENSOR).
+        'tensor_parallel': {'type': 'integer', 'minimum': 1},
     },
 }
 
