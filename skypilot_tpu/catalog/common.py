@@ -75,10 +75,15 @@ def catalog_generated_at(filename: str) -> Optional[float]:
         return None   # corrupt sidecar = unknown provenance, not a crash
 
 
-def write_catalog_metadata(path: str) -> None:
-    """Sidecar writer for data_fetchers: stamps `generated_at` now."""
+def write_catalog_metadata(path: str,
+                           generated_at: Optional[float] = None) -> None:
+    """Sidecar writer for data_fetchers: stamps `generated_at` — now by
+    default, or the DATA's recording time when the fetch came from a
+    recorded fixture (replaying an old recording must not make stale
+    prices look fresh)."""
     with open(path + '.meta.json', 'w', encoding='utf-8') as f:
-        json.dump({'generated_at': time.time()}, f)
+        json.dump({'generated_at': (time.time() if generated_at is None
+                                    else float(generated_at))}, f)
 
 
 def catalog_staleness(filename: str) -> Dict[str, object]:

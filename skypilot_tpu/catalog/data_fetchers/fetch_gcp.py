@@ -42,6 +42,47 @@ _VM_SKU_RE = re.compile(
     + r')(?: Instance)? (Core|Ram) running', re.IGNORECASE)
 
 
+def _unwrap_fixture(obj):
+    """Fixture files may wrap the raw page list with recording
+    provenance: {"recorded_at": "YYYY-MM-DD", "pages": [...]}.  A bare
+    list/dict of pages (vcr-style) stays supported."""
+    if isinstance(obj, dict) and 'pages' in obj:
+        obj = obj['pages']
+    return [obj] if isinstance(obj, dict) else list(obj)
+
+
+def fixture_recorded_at() -> Optional[float]:
+    """Epoch seconds the active billing fixture was recorded, from its
+    `recorded_at` field ("YYYY-MM-DD" or epoch seconds).  None when no
+    fixture is active or it carries no provenance.  Threaded into the
+    written catalogs' .meta.json so staleness tracks the DATA's age,
+    not the time someone last replayed the recording."""
+    fixture = os.environ.get('SKYTPU_BILLING_FIXTURE')
+    if not fixture:
+        return None
+    try:
+        with open(fixture, encoding='utf-8') as f:
+            obj = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if not isinstance(obj, dict):
+        return None
+    raw = obj.get('recorded_at')
+    if raw is None:
+        return None
+    try:
+        return float(raw)
+    except (TypeError, ValueError):
+        pass
+    try:
+        import datetime
+        return datetime.datetime.strptime(
+            str(raw), '%Y-%m-%d').replace(
+                tzinfo=datetime.timezone.utc).timestamp()
+    except ValueError:
+        return None
+
+
 def iter_sku_pages() -> Iterable[dict]:
     """Yield billing-API SKU response pages, from the recorded fixture
     (SKYTPU_BILLING_FIXTURE) or the live API."""
@@ -49,7 +90,7 @@ def iter_sku_pages() -> Iterable[dict]:
     if fixture:
         with open(fixture, encoding='utf-8') as f:
             pages = json.load(f)
-        yield from (pages if isinstance(pages, list) else [pages])
+        yield from _unwrap_fixture(pages)
         return
     try:
         import googleapiclient.discovery  # type: ignore
@@ -76,6 +117,7 @@ def _sku_price(sku: dict) -> Optional[float]:
 def fetch_tpu_prices(pages: Optional[Iterable[dict]] = None
                      ) -> List[Dict[str, object]]:
     rows: List[Dict[str, object]] = []
+    pages = _unwrap_fixture(pages) if pages is not None else None
     for resp in (pages if pages is not None else iter_sku_pages()):
         for sku in resp.get('skus', []):
             m = _TPU_SKU_RE.search(sku.get('description', ''))
@@ -100,6 +142,7 @@ def fetch_vm_unit_prices(pages: Optional[Iterable[dict]] = None
                          ) -> Dict[Tuple[str, str, str, bool], float]:
     """{(family, 'core'|'ram', region, spot): unit $/hr}."""
     out: Dict[Tuple[str, str, str, bool], float] = {}
+    pages = _unwrap_fixture(pages) if pages is not None else None
     for resp in (pages if pages is not None else iter_sku_pages()):
         for sku in resp.get('skus', []):
             desc = sku.get('description', '')
@@ -186,7 +229,10 @@ def main() -> int:
             # without known zones are skipped rather than invented).
             for zone in known_zones.get((gen, region), []):
                 f.write(f'{gen},{region},{zone},{od},{sp}\n')
-    common.write_catalog_metadata(path)   # staleness provenance
+    # Staleness provenance: a fixture replay stamps the RECORDING
+    # date, so the catalog's age reflects the data, not the replay.
+    recorded_at = fixture_recorded_at()
+    common.write_catalog_metadata(path, generated_at=recorded_at)
     print(f'Wrote {path}')
 
     # VM catalog: price the bundled shapes from core/ram unit SKUs.
@@ -215,7 +261,7 @@ def main() -> int:
                     f.write(f"{b['instance_type']},{b['vcpus']},"
                             f"{b['memory_gb']},{b['price_hr']},"
                             f"{b['spot_price_hr']}\n")
-        common.write_catalog_metadata(vm_path)
+        common.write_catalog_metadata(vm_path, generated_at=recorded_at)
         print(f'Wrote {vm_path}')
     return 0
 

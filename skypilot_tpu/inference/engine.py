@@ -33,6 +33,28 @@ invariant (models/llama.py _decode_attend): attention masks k_pos >
 q_pos, and inserts overwrite a slot's whole cache, so a reused slot never
 leaks its previous request's KV.
 
+Long prompts (chunked prefill): prompts longer than the largest bucket
+no longer fuse into one dispatch — they stream through a per-request
+SCRATCH cache in bucket-sized chunks, one chunk dispatched per loop
+iteration between decode calls, so a 128k prefill delays the in-flight
+decode batch by at most ONE chunk instead of monopolizing the device.
+Each chunk writes its K/V at absolute positions and attends over the
+accumulated cache (models/llama.py _decode_attend S>1); the final chunk
+samples the prompt's first token and scatters the scratch cache into
+the request's slot in the same dispatch — from there the request is
+indistinguishable from a bucket-admitted one.  Prompts up to
+`max_seq_len - 1` (or the `max_prompt_len` knob) are admissible.
+Prompts that fit one bucket keep the fused single-dispatch path
+byte-for-byte, so short-prompt bench numbers are untouched.
+
+Weight swaps (`update_params`) are double-buffered and in-flight-safe:
+the new tree is STAGED into the engine's committed layouts/shardings
+(device_put overlaps with serving), INSTALLED at the loop's next
+dispatch boundary, and the old buffers are RELEASED once the last call
+dispatched against them has retired — no drain, serving never stops.
+This is what rolling weight refresh and the RL rollout/update
+alternation ride on.
+
 Tensor parallelism (13B-70B serving): pass `EngineConfig(mesh=...)`
 (parallel/mesh.py build_serve_mesh) and every program above runs
 mesh-sharded — params via the model's logical-axis annotations
@@ -78,6 +100,11 @@ class EngineConfig:
     eos_id: Optional[int] = None       # None: never stop on a token
     temperature: float = 0.0           # 0 => greedy
     seed: int = 0
+    # Admission cap for prompts.  None: anything up to max_seq_len - 1
+    # is admissible (prompts beyond the largest bucket go through
+    # chunked prefill).  Deployments set a lower cap to bound the
+    # per-request prefill work a single caller can demand.
+    max_prompt_len: Optional[int] = None
     # Tensor parallelism: a jax.sharding.Mesh whose `tensor_axis` names
     # the axis attention heads / MLP hidden shard over (build one with
     # parallel/mesh.py build_serve_mesh).  None = single-device engine.
@@ -122,6 +149,17 @@ class _Slot:
         self.done = False
 
 
+class _ChunkedPrefill:
+    """Host state of one long prompt mid-chunked-prefill: the scratch
+    cache accumulating its K/V and how far into the prompt it is."""
+    __slots__ = ('request', 'scratch', 'offset')
+
+    def __init__(self, request: Request, scratch) -> None:
+        self.request = request
+        self.scratch = scratch
+        self.offset = 0          # prompt tokens already in the scratch
+
+
 class DecodeEngine:
     """Slot-based continuous batching over a Llama-family model.
 
@@ -152,19 +190,43 @@ class DecodeEngine:
         # In-flight decode call (pipelined loop): (device out, snapshot
         # of the slots it covers).  Processed one iteration later.
         self._inflight = None
+        # Long prompts (beyond the largest bucket) queue here and go
+        # through chunked prefill, one at a time.
+        self._long_q: 'queue.Queue[Request]' = queue.Queue()
+        self._chunked: Optional[_ChunkedPrefill] = None
+        self._scratch_fn = None
+        # Prompt tokens accepted but not yet prefilled (queued requests
+        # + the un-prefilled remainder of the active chunked prompt).
+        # Writers hold _submit_lock; the loop's gauge read is a bare
+        # GIL-atomic int read (a one-iteration-stale value is harmless,
+        # and the idle loop must not take the lock every millisecond).
+        self._queued_tokens = 0
+        # Double-buffered weight swap: update_params stages here; the
+        # loop installs at its next dispatch boundary and retires the
+        # old tree once no dispatched call references it.
+        self._params_lock = threading.Lock()
+        self._staged_params: Optional[tuple] = None
+        self._retiring_params: List[Any] = []
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._last_gauges: Optional[tuple] = None
         self.error: Optional[BaseException] = None
         self._fmt_params = None
         self._prefill_compiled: Dict[tuple, Any] = {}
+        self._chunk_compiled: Dict[tuple, Any] = {}
         # Mesh-sharded serving state (None on the single-device path).
         self._mesh = config.mesh
         self._param_shardings = None
         self._cache_shardings = None
         self._repl = None
+        self._scratch_shardings = None
         if self._mesh is not None:
             self._setup_mesh()
+        # True when the installed tree is an engine-private device copy
+        # (mesh/TPU-layout device_put) that update_params may DELETE
+        # after a swap; on the plain path the tree is the caller's and
+        # is only ever dereferenced.
+        self._params_owned = self._mesh is not None
         self._build_fns()
         self._init_cache()
         if jax.default_backend() == 'tpu' and self._mesh is None:
@@ -228,10 +290,17 @@ class DecodeEngine:
 
         cache_abs = jax.eval_shape(self._make_cache, self.params)
         self._cache_shardings = jax.tree.map(_kv_or_repl, cache_abs)
+        # The chunked-prefill scratch cache [1, n_kv_heads, max_len, D]
+        # shards over kv heads exactly like the big cache.
+        scratch_abs = jax.eval_shape(lambda p: self._make_cache(p, 1),
+                                     self.params)
+        self._scratch_shardings = jax.tree.map(_kv_or_repl, scratch_abs)
 
-    def _make_cache(self, params):
-        """Trace a dummy decode batch; returns the big per-layer cache."""
-        n = self.cfg.n_slots
+    def _make_cache(self, params, n: Optional[int] = None):
+        """Trace a dummy decode batch; returns the per-layer cache for
+        `n` slots (default: the engine's big cache; n=1: the chunked-
+        prefill scratch)."""
+        n = self.cfg.n_slots if n is None else n
         tokens = jnp.zeros((n, 1), jnp.int32)
         positions = jnp.zeros((n, 1), jnp.int32)
         _, cache = self.model.apply(
@@ -307,12 +376,61 @@ class DecodeEngine:
             out = jnp.concatenate([last_tokens[None, :], toks], axis=0)
             return out, cache, last, lens                    # [T+1, B]
 
+        def prefill_chunk(params, scratch, tokens, offset):
+            """One INTERMEDIATE chunk of a long prompt: tokens [1, C]
+            (all valid) land in the scratch cache at absolute positions
+            offset..offset+C and attend over everything before them.
+            Logits are never read, so XLA drops the lm-head matmul."""
+            c = tokens.shape[1]
+            positions = offset + jnp.arange(c)[None, :]
+            _, cache = model.apply(
+                {'params': params, 'cache': scratch}, tokens,
+                positions=positions, decode=True, mutable=['cache'])
+            return cache['cache']
+
+        def prefill_chunk_insert(params, big_cache, last_toks, lens,
+                                 scratch, tokens, length, offset,
+                                 total_len, slot, rng):
+            """FINAL chunk + slot insert in one dispatch: run the
+            bucket-padded last chunk (`length` valid rows) against the
+            scratch cache, sample the prompt's first token from its
+            last valid position, and scatter the accumulated scratch
+            into `slot` of the big cache.  Padding rows write garbage
+            at positions >= total_len — masked (k_pos > q_pos) until
+            the decode scatter overwrites them, the same invariant the
+            fused bucket path relies on."""
+            c = tokens.shape[1]
+            positions = offset + jnp.arange(c)[None, :]
+            logits, cache = model.apply(
+                {'params': params, 'cache': scratch}, tokens,
+                positions=positions, decode=True, mutable=['cache'])
+            last = jax.lax.dynamic_index_in_dim(logits, length - 1,
+                                                axis=1, keepdims=False)
+            first = sample(last, rng)                        # [1]
+
+            def _ins(big, small):
+                return big.at[slot].set(small[0])
+
+            big_cache = jax.tree_util.tree_map(_ins, big_cache,
+                                               cache['cache'])
+            return (big_cache, last_toks.at[slot].set(first[0]),
+                    lens.at[slot].set(total_len))
+
         self._prefill_raw = prefill_insert
         self._decode_raw = decode
+        self._chunk_raw = prefill_chunk
+        self._chunk_insert_raw = prefill_chunk_insert
         if self._mesh is None:
             self._prefill_insert = jax.jit(prefill_insert,
                                            donate_argnums=(1, 2, 3))
             self._decode = jax.jit(decode, donate_argnums=(1, 2, 3))
+            self._prefill_chunk = jax.jit(prefill_chunk,
+                                          donate_argnums=(1,))
+            # No scratch donation here: a [1, ...] scratch leaf can
+            # never alias the [n_slots, ...] outputs, and an unusable
+            # donation only buys a warning.
+            self._chunk_insert = jax.jit(prefill_chunk_insert,
+                                         donate_argnums=(1, 2, 3))
         else:
             # Pin every program to the engine's committed shardings:
             # donated state (cache/last/lens) comes back in the same
@@ -330,6 +448,14 @@ class DecodeEngine:
                 decode, donate_argnums=(1, 2, 3),
                 in_shardings=(p_sh, c_sh, r, r, r),
                 out_shardings=(r, c_sh, r, r))
+            s_sh = self._scratch_shardings
+            self._prefill_chunk = jax.jit(
+                prefill_chunk, donate_argnums=(1,),
+                in_shardings=(p_sh, s_sh, r, r), out_shardings=s_sh)
+            self._chunk_insert = jax.jit(
+                prefill_chunk_insert, donate_argnums=(1, 2, 3),
+                in_shardings=(p_sh, c_sh, r, r, s_sh, r, r, r, r, r, r),
+                out_shardings=(c_sh, r, r))
 
     def _init_cache(self):
         """Materialize the big cache by tracing a dummy decode batch.
@@ -365,10 +491,7 @@ class DecodeEngine:
         """
         from jax.experimental.layout import Format, Layout
 
-        def _abs(tree):
-            return jax.tree.map(
-                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), tree)
-
+        _abs = self._abs_tree
         auto = jax.tree.map(lambda _: Format(Layout.AUTO), self.params)
         rng_abs = jax.ShapeDtypeStruct(self._rng.shape, self._rng.dtype)
         compiled = jax.jit(
@@ -395,6 +518,7 @@ class DecodeEngine:
         self._lens_d = jax.device_put(self._lens_d, self._fmt_lens,
                                       donate=True)
         self._decode = compiled
+        self._params_owned = True    # relaid-out tree is engine-private
 
     def _prefill_for(self, bucket: int, padded_n: int):
         """Prefill executable for one (bucket, batch) shape, pinned to
@@ -405,10 +529,7 @@ class DecodeEngine:
         key = (bucket, padded_n)
         fn = self._prefill_compiled.get(key)
         if fn is None:
-            def _abs(tree):
-                return jax.tree.map(
-                    lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), tree)
-
+            _abs = self._abs_tree
             toks = jax.ShapeDtypeStruct((padded_n, bucket), jnp.int32)
             vec = jax.ShapeDtypeStruct((padded_n,), jnp.int32)
             rng_abs = jax.ShapeDtypeStruct(self._rng.shape, self._rng.dtype)
@@ -427,23 +548,106 @@ class DecodeEngine:
             self._prefill_compiled[key] = fn
         return fn
 
+    # ----- chunked prefill executables ---------------------------------------
+    def _abs_tree(self, tree):
+        return jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), tree)
+
+    def _new_scratch(self):
+        """Fresh zeroed single-request scratch cache, in the engine's
+        committed shardings under a mesh (default layouts otherwise —
+        the chunk programs keep it there end to end)."""
+        if self._scratch_fn is None:
+            make = lambda p: self._make_cache(p, 1)  # noqa: E731
+            if self._scratch_shardings is not None:
+                self._scratch_fn = jax.jit(
+                    make, out_shardings=self._scratch_shardings)
+            else:
+                self._scratch_fn = jax.jit(make)
+        return self._scratch_fn(self.params)
+
+    def _chunk_for(self, width: int):
+        """Intermediate-chunk executable for one chunk width, pinned to
+        the decode-chosen param layouts on TPU (plain jit elsewhere —
+        the scratch cache always rides default layouts)."""
+        if self._fmt_params is None:
+            return self._prefill_chunk
+        key = ('chunk', width)
+        fn = self._chunk_compiled.get(key)
+        if fn is None:
+            toks = jax.ShapeDtypeStruct((1, width), jnp.int32)
+            scalar = jax.ShapeDtypeStruct((), jnp.int32)
+            scratch_abs = jax.eval_shape(lambda p: self._make_cache(p, 1),
+                                         self._abs_tree(self.params))
+            fn = jax.jit(
+                self._chunk_raw, donate_argnums=(1,),
+                in_shardings=(self._fmt_params, None, None, None),
+            ).lower(self._abs_tree(self.params), scratch_abs, toks,
+                    scalar).compile()
+            self._chunk_compiled[key] = fn
+        return fn
+
+    def _chunk_insert_for(self, bucket: int):
+        """Final-chunk-plus-insert executable for one bucket width: the
+        donated big cache / last / lens must come back in the layouts
+        the decode executable was pinned to."""
+        if self._fmt_params is None:
+            return self._chunk_insert
+        key = ('insert', bucket)
+        fn = self._chunk_compiled.get(key)
+        if fn is None:
+            toks = jax.ShapeDtypeStruct((1, bucket), jnp.int32)
+            scalar = jax.ShapeDtypeStruct((), jnp.int32)
+            rng_abs = jax.ShapeDtypeStruct(self._rng.shape, self._rng.dtype)
+            scratch_abs = jax.eval_shape(lambda p: self._make_cache(p, 1),
+                                         self._abs_tree(self.params))
+            fn = jax.jit(
+                self._chunk_insert_raw, donate_argnums=(1, 2, 3),
+                in_shardings=(self._fmt_params, self._fmt_cache,
+                              self._fmt_last, self._fmt_lens,
+                              None, None, None, None, None, None, None),
+                out_shardings=(self._fmt_cache, self._fmt_last,
+                               self._fmt_lens),
+            ).lower(self._abs_tree(self.params), self._abs_tree(self._cache),
+                    self._abs_tree(self._last_d),
+                    self._abs_tree(self._lens_d), scratch_abs, toks,
+                    scalar, scalar, scalar, scalar, rng_abs).compile()
+            self._chunk_compiled[key] = fn
+        return fn
+
     # ----- public API --------------------------------------------------------
+    @property
+    def max_prompt_len(self) -> int:
+        """Longest admissible prompt: max_seq_len - 1 (one generated
+        token must fit the cache), optionally capped by the
+        EngineConfig.max_prompt_len knob."""
+        limit = self.model.cfg.max_seq_len - 1
+        if self.cfg.max_prompt_len is not None:
+            limit = min(limit, self.cfg.max_prompt_len)
+        return limit
+
     def submit(self, prompt_ids: List[int],
                max_new_tokens: int = 64) -> Request:
-        max_prompt = self.cfg.prefill_buckets[-1]
-        limit = self.model.cfg.max_seq_len
-        if len(prompt_ids) > max_prompt or len(prompt_ids) >= limit:
+        limit = self.max_prompt_len
+        if len(prompt_ids) > limit:
             raise ValueError(
-                f'prompt len {len(prompt_ids)} exceeds the largest '
-                f'prefill bucket {max_prompt} (cache length {limit})')
-        if len(prompt_ids) + max_new_tokens > limit:
-            max_new_tokens = limit - len(prompt_ids)
+                f'prompt len {len(prompt_ids)} exceeds max_prompt_len '
+                f'{limit} (model max_seq_len '
+                f'{self.model.cfg.max_seq_len})')
+        cache_len = self.model.cfg.max_seq_len
+        if len(prompt_ids) + max_new_tokens > cache_len:
+            max_new_tokens = cache_len - len(prompt_ids)
         req = Request(list(prompt_ids), max_new_tokens)
         with self._submit_lock:
             if self.error is not None:
                 raise RuntimeError(
                     f'decode engine is dead: {self.error!r}')
-            self._prefill_q.put(req)
+            # Prompts beyond the largest bucket take the chunked path.
+            if len(prompt_ids) > self.cfg.prefill_buckets[-1]:
+                self._long_q.put(req)
+            else:
+                self._prefill_q.put(req)
+            self._queued_tokens += len(prompt_ids)
         metrics_lib.inc_counter('skytpu_engine_requests_total')
         return req
 
@@ -453,39 +657,88 @@ class DecodeEngine:
         return self.submit(prompt_ids, max_new_tokens).tokens()
 
     def drain(self) -> None:
-        """Run the pipelined loop until FULLY idle: queue empty, no
-        active slots, nothing in flight (the last retire typically
-        leaves one garbage call in flight — see step_pipelined)."""
+        """Run the pipelined loop until FULLY idle: queues empty, no
+        active or chunk-prefilling request, nothing in flight (the last
+        retire typically leaves one garbage call in flight — see
+        step_pipelined)."""
         while (self._inflight is not None or
                not self._prefill_q.empty() or
+               not self._long_q.empty() or
+               self._chunked is not None or
                any(s is not None for s in self._slots)):
             self.step_pipelined()
 
+    def _stage(self, params):
+        """Place a new tree into the engine's committed layouts /
+        shardings.  Returns (tree, owned): owned marks a device copy
+        the engine is normally the only holder of, so dropping the
+        engine's reference at retire time frees its HBM."""
+        if self._fmt_params is not None:
+            # TPU layout path: lay the new tree out into the formats
+            # the decode executable was pinned to.
+            return jax.device_put(params, self._fmt_params), True
+        if self._param_shardings is not None:
+            # Mesh path: land the new tree (host numpy from an RL
+            # learner, or another placement) in the SAME committed
+            # shardings — the compiled programs keep hitting cache.
+            import flax.linen as nn
+            return jax.device_put(nn.meta.unbox(params),
+                                  self._param_shardings), True
+        return params, False
+
     def update_params(self, params) -> None:
-        """Swap the served weights in place (RL loops, rolling weight
-        refresh): keeps every compiled program and the TPU layout
-        optimization — the new tree is laid out into the formats the
-        decode executable was pinned to.  The engine must be idle (no
-        active slots, queue drained, nothing in flight): a mid-decode
-        swap would mix policies within one request."""
-        with self._submit_lock:
-            if (self._inflight is not None or
-                    not self._prefill_q.empty() or
-                    any(s is not None for s in self._slots)):
-                raise RuntimeError(
-                    'update_params requires an idle engine (drain '
-                    'requests first)')
-            if self._fmt_params is not None:
-                import jax as _jax
-                params = _jax.device_put(params, self._fmt_params)
-            elif self._param_shardings is not None:
-                # Mesh path: land the new tree (host numpy from an RL
-                # learner, or another placement) in the SAME committed
-                # shardings — the compiled programs keep hitting cache.
-                import flax.linen as nn
-                params = jax.device_put(nn.meta.unbox(params),
-                                        self._param_shardings)
-            self.params = params
+        """Swap the served weights WITHOUT draining (rolling weight
+        refresh, the RL rollout/update alternation): double-buffered
+        in-flight swap.  The new tree is STAGED into the engine's
+        committed layouts/shardings here (the device_put overlaps with
+        live serving), INSTALLED by the loop at its next dispatch
+        boundary — so every individual dispatch sees exactly one tree
+        and every compiled program stays hot — and the old buffers are
+        RELEASED once the last call dispatched against them has
+        retired.  Active slots and in-flight calls keep running; the
+        first dispatch after the install (mid-request included — that
+        is the rolling-refresh contract) samples from the new weights.
+
+        Called with no loop thread running (manual step()/RL
+        alternation), the caller IS the dispatcher, so the install
+        happens before this returns."""
+        staged = self._stage(params)
+        with self._params_lock:
+            # Re-staged before install: the never-served copy's only
+            # reference drops here and it frees immediately.
+            self._staged_params = staged
+        if self._thread is None or not self._thread.is_alive():
+            self._install_staged()
+
+    def _install_staged(self) -> None:
+        """Dispatch-boundary half of update_params: swap the staged
+        tree in; the outgoing tree joins the retiring list until every
+        call dispatched against it has retired."""
+        with self._params_lock:
+            staged, self._staged_params = self._staged_params, None
+        if staged is None:
+            return
+        old, old_owned = self.params, self._params_owned
+        self.params, self._params_owned = staged
+        if old_owned:
+            self._retiring_params.append(old)
+        if self._inflight is None:
+            self._release_retiring()
+
+    def _release_retiring(self) -> None:
+        """Drop the engine's references to swapped-out param trees.
+        Called right after the pipelined sync — every call dispatched
+        before the install has retired by then, so in the production
+        case (the engine holds the only reference to its staged copy)
+        the old tree's HBM frees here, bounding the double-buffer
+        window to one loop iteration.  Reference-drop rather than
+        explicit Array.delete(): device_put may ALIAS caller buffers
+        (zero-copy when placement already matches), and deleting an
+        aliased buffer would corrupt the caller's live tree — the
+        runtime's refcount frees exactly when the last holder lets
+        go."""
+        if self._retiring_params:
+            self._retiring_params = []
 
     def prewarm(self) -> None:
         """Compile every prefill shape up front (TPU layout path only).
@@ -516,6 +769,16 @@ class DecodeEngine:
         for bucket in self.cfg.prefill_buckets:
             for size in self._prewarm_sizes():
                 self._prefill_for(bucket, size)
+        if self._chunking_possible():
+            self._new_scratch()     # compiles the scratch-init program
+            self._chunk_for(self.cfg.prefill_buckets[-1])
+            for bucket in self.cfg.prefill_buckets:
+                self._chunk_insert_for(bucket)
+
+    def _chunking_possible(self) -> bool:
+        """True when an admissible prompt can exceed the largest bucket
+        (so the chunked-prefill programs are reachable)."""
+        return self.max_prompt_len > self.cfg.prefill_buckets[-1]
 
     def _prewarm_sizes(self):
         """Padded admission-group row counts: powers of two up to and
@@ -546,6 +809,23 @@ class DecodeEngine:
                  self._lens_d) = self._prefill_insert(
                      self.params, self._cache, self._last_d, self._lens_d,
                      tokens, ones, zeros, zeros, self._next_rng())
+        if self._chunking_possible():
+            # Chunked-prefill shapes: one intermediate-chunk program
+            # (largest bucket) + one final-insert program per bucket.
+            # Dummy dispatches scribble slot 0 like the loop above.
+            chunk = self.cfg.prefill_buckets[-1]
+            one = jnp.ones((), jnp.int32)
+            zero = jnp.zeros((), jnp.int32)
+            for bucket in self.cfg.prefill_buckets:
+                scratch = self._prefill_chunk(
+                    self.params, self._new_scratch(),
+                    jnp.zeros((1, chunk), jnp.int32), zero)
+                (self._cache, self._last_d,
+                 self._lens_d) = self._chunk_insert(
+                     self.params, self._cache, self._last_d,
+                     self._lens_d, scratch,
+                     jnp.zeros((1, bucket), jnp.int32), one, zero, one,
+                     zero, self._next_rng())
         _, self._cache, self._last_d, self._lens_d = self._decode(
             self.params, self._cache, self._last_d, self._lens_d,
             self._next_rng())
@@ -608,9 +888,11 @@ class DecodeEngine:
             jnp.asarray(valid), self._next_rng())
         for slot_id, req in group:
             self._slots[slot_id] = _Slot(req, len(req.prompt_ids))
+        n_tokens = sum(len(r.prompt_ids) for _, r in group)
+        with self._submit_lock:
+            self._queued_tokens -= n_tokens
         metrics_lib.inc_counter('skytpu_engine_prefill_tokens_total',
-                                float(sum(len(r.prompt_ids)
-                                          for _, r in group)))
+                                float(n_tokens))
 
     def _emit(self, req: Request, tok: int) -> None:
         req.emitted += 1
@@ -649,6 +931,16 @@ class DecodeEngine:
         free = [i for i in range(self.cfg.n_slots)
                 if self._slots[i] is None]
         free += [i for i in (handoff or []) if self._slots[i] is not None]
+        if free and self._final_insert_pending():
+            # Reserve one slot for the active long prompt's final
+            # chunk-insert (it claims a slot in _step_chunked, which
+            # runs BEFORE the next admission): under sustained short
+            # traffic, handing every freed slot to _prefill_q would
+            # starve the insert forever — unbounded long-prompt TTFT.
+            # pop(0): prefer reserving a truly-free slot (the list's
+            # head) so the insert can claim it immediately; handoff
+            # slots at the tail only free after the in-flight call.
+            free.pop(0)
         by_bucket: Dict[int, list] = {}
         while free and not self._prefill_q.empty():
             try:
@@ -661,10 +953,75 @@ class DecodeEngine:
         for bucket, group in by_bucket.items():
             self._admit_group(bucket, group)
 
+    def _final_insert_pending(self) -> bool:
+        """True when the active chunked prefill has reached its final
+        chunk and is waiting on a free slot to insert into."""
+        cp = self._chunked
+        return (cp is not None and
+                len(cp.request.prompt_ids) - cp.offset
+                <= self.cfg.prefill_buckets[-1])
+
+    def _step_chunked(self) -> bool:
+        """Dispatch at most ONE chunk of the active long-prompt
+        prefill.  Called once per loop iteration right after the decode
+        dispatch, so on device the order is decode, chunk, decode,
+        chunk, ... — the decode batch is never delayed by more than one
+        chunk-sized call however long the prompt is.  Intermediate
+        chunks are largest-bucket-wide; the final chunk pads to the
+        smallest fitting bucket, samples the first token and inserts
+        the scratch cache into a free slot (waiting for one to retire
+        if none is free — decode keeps running meanwhile).  Returns
+        True if a dispatch was made."""
+        if self._chunked is None:
+            try:
+                req = self._long_q.get_nowait()
+            except queue.Empty:
+                return False
+            self._chunked = _ChunkedPrefill(req, self._new_scratch())
+        cp = self._chunked
+        prompt = cp.request.prompt_ids
+        rem = len(prompt) - cp.offset
+        chunk = self.cfg.prefill_buckets[-1]
+        if rem > chunk:
+            buf = np.zeros((1, chunk), np.int32)
+            buf[0] = prompt[cp.offset:cp.offset + chunk]
+            cp.scratch = self._chunk_for(chunk)(
+                self.params, cp.scratch, jnp.asarray(buf),
+                jnp.asarray(cp.offset, jnp.int32))
+            cp.offset += chunk
+            done = chunk
+        else:
+            slot_id = next((i for i in range(self.cfg.n_slots)
+                            if self._slots[i] is None), None)
+            if slot_id is None:
+                return False             # all slots busy: retry later
+            bucket = self._bucket(rem)
+            buf = np.zeros((1, bucket), np.int32)
+            buf[0, :rem] = prompt[cp.offset:]
+            (self._cache, self._last_d,
+             self._lens_d) = self._chunk_insert_for(bucket)(
+                 self.params, self._cache, self._last_d, self._lens_d,
+                 cp.scratch, jnp.asarray(buf),
+                 jnp.asarray(rem, jnp.int32),
+                 jnp.asarray(cp.offset, jnp.int32),
+                 jnp.asarray(len(prompt), jnp.int32),
+                 jnp.asarray(slot_id, jnp.int32), self._next_rng())
+            self._slots[slot_id] = _Slot(cp.request, len(prompt))
+            self._chunked = None
+            done = rem
+        with self._submit_lock:
+            self._queued_tokens -= done
+        metrics_lib.inc_counter('skytpu_engine_prefill_chunks_total')
+        metrics_lib.inc_counter('skytpu_engine_prefill_tokens_total',
+                                float(done))
+        return True
+
     def _sample_gauges(self, n_active: int) -> None:
         """Loop-thread occupancy/queue gauges; skipped when unchanged so
         the idle 1 kHz loop does not hammer the registry lock."""
-        sample = (n_active, self._prefill_q.qsize())
+        sample = (n_active,
+                  self._prefill_q.qsize() + self._long_q.qsize(),
+                  self._queued_tokens)
         if sample == self._last_gauges:
             return
         self._last_gauges = sample
@@ -674,23 +1031,32 @@ class DecodeEngine:
                               n_active / self.cfg.n_slots)
         metrics_lib.set_gauge('skytpu_engine_queue_depth',
                               float(sample[1]))
+        # Long-prompt backlog: tokens accepted but not yet prefilled
+        # (the LB federates this per replica, so a scrape sees where
+        # chunked prefills are queueing up).
+        metrics_lib.set_gauge('skytpu_engine_queued_prefill_tokens',
+                              float(max(sample[2], 0)))
 
     def step(self) -> int:
         """One SYNCHRONOUS engine iteration (admit + decode + process).
         Returns #active slots.  Exposed for tests and debugging; the
         serving loop and benchmarks use step_pipelined, which overlaps
         the host work with the next device call."""
+        self._install_staged()
+        self._step_chunked()
         self._admit_free()
         active = [i for i in range(self.cfg.n_slots)
                   if self._slots[i] is not None]
         self._sample_gauges(len(active))
         if not active:
+            self._release_retiring()
             return 0
         out, self._cache, self._last_d, self._lens_d = self._decode(
             self.params, self._cache, self._last_d, self._lens_d,
             self._next_rng())
         out = np.asarray(out)            # [T+1, B] — the ONE sync per step
         self._process_rows(out, {i: self._slots[i] for i in active})
+        self._release_retiring()
         return len(active)
 
     def step_pipelined(self) -> int:
@@ -708,9 +1074,19 @@ class DecodeEngine:
         before its first token.  At saturation the throughput win
         dominates; TTFT under light load pays ~one call of latency.
 
-        Returns #slots active in the dispatched call (0 = fully idle and
-        nothing in flight).
+        Staged weight swaps install at the TOP of the iteration — the
+        dispatch boundary: the call dispatched below and everything
+        after it runs the new tree, and the old tree is released right
+        after the in-flight sync (the last point a call dispatched
+        against it can retire behind).  A long prompt's chunked prefill
+        dispatches at most one chunk per iteration, right behind the
+        decode call, so decode is interleaved chunk-by-chunk instead of
+        stalling behind the whole prefill.
+
+        Returns #slots active in the dispatched call plus any chunk
+        dispatched (0 = fully idle and nothing in flight).
         """
+        self._install_staged()
         active = [i for i in range(self.cfg.n_slots)
                   if self._slots[i] is not None]
         self._sample_gauges(len(active))
@@ -720,10 +1096,12 @@ class DecodeEngine:
                 self.params, self._cache, self._last_d, self._lens_d,
                 self._next_rng())
             dispatched = (out_d, {i: self._slots[i] for i in active})
+        chunked = self._step_chunked()   # queues behind the decode call
         if self._inflight is not None:
             out_prev, snapshot = self._inflight
             self._inflight = None
             self._process_rows(np.asarray(out_prev), snapshot)
+        self._release_retiring()
         self._inflight = dispatched
         # Admissions AFTER processing: retired slots are free now, and
         # slots whose occupant will PROVABLY finish inside the call just
@@ -742,7 +1120,7 @@ class DecodeEngine:
                 if remaining <= rows_to_come:
                     handoff.append(i)
         self._admit_free(handoff)
-        return len(active)
+        return len(active) + (1 if chunked else 0)
 
     def _process_rows(self, out: np.ndarray, snapshot: Dict[int, _Slot]
                       ) -> None:
@@ -808,13 +1186,19 @@ class DecodeEngine:
                             slot.request.finished_at = time.perf_counter()
                             slot.request.out.put(None)
                         self._slots[i] = None
-                    while True:
-                        try:
-                            req = self._prefill_q.get_nowait()
-                        except queue.Empty:
-                            break
-                        req.finished_at = time.perf_counter()
-                        req.out.put(None)
+                    if self._chunked is not None:
+                        cp, self._chunked = self._chunked, None
+                        cp.request.finished_at = time.perf_counter()
+                        cp.request.out.put(None)
+                    for pending in (self._prefill_q, self._long_q):
+                        while True:
+                            try:
+                                req = pending.get_nowait()
+                            except queue.Empty:
+                                break
+                            req.finished_at = time.perf_counter()
+                            req.out.put(None)
+                    self._queued_tokens = 0
                 return
             if n == 0:
                 time.sleep(0.001)

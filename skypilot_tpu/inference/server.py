@@ -13,6 +13,11 @@ launched by examples/tpu/v6e/serve-llama2-7b.yaml).  Routes:
 - POST /v1/completions  {"prompt": "...", "max_tokens": N} or
                         {"prompt_ids": [...], "max_tokens": N}
                         -> {"ids": [...], "text": "...", "usage": {...}}
+                        Prompts longer than the largest prefill bucket
+                        are admitted via chunked prefill (up to
+                        max_prompt_len, default max_seq_len - 1); a
+                        prompt beyond that limit gets 413 with the
+                        limit in the body.
 
 Text prompts use a byte-level tokenizer (token id = byte value), which is
 model-agnostic and dependency-free; real deployments pass `prompt_ids`
@@ -69,7 +74,13 @@ def build_app(engine: DecodeEngine) -> web.Application:
         try:
             req = engine.submit(ids, max_tokens)
         except ValueError as e:
-            return web.json_response({'error': str(e)}, status=400)
+            # Admission rejection: the prompt exceeds max_prompt_len
+            # (engine message carries the limit).  413, not 400 — the
+            # request was well-formed, just too large; clients can read
+            # the limit and re-chunk.
+            return web.json_response(
+                {'error': str(e),
+                 'max_prompt_len': engine.max_prompt_len}, status=413)
         out = await asyncio.get_event_loop().run_in_executor(
             None, req.tokens)
         return web.json_response({
@@ -103,6 +114,15 @@ def main() -> None:
     parser.add_argument('--n-slots', type=int, default=8)
     parser.add_argument('--max-seq-len', type=int, default=1024)
     parser.add_argument(
+        '--max-prompt-len', type=int,
+        default=int(os.environ.get('SKYTPU_SERVE_MAX_PROMPT_LEN', '0')),
+        help='longest admissible prompt in tokens (0 = model limit, '
+        'max_seq_len - 1).  Prompts beyond the largest prefill bucket '
+        'are chunked and interleaved with decode, so this is a policy '
+        'cap, not a capability one.  Serve specs set it via '
+        'service.max_prompt_len, which arrives here as '
+        'SKYTPU_SERVE_MAX_PROMPT_LEN.')
+    parser.add_argument(
         '--tensor', type=int,
         default=int(os.environ.get('SKYTPU_SERVE_TENSOR', '1')),
         help='tensor-parallel degree: shard weights/KV cache over this '
@@ -119,6 +139,11 @@ def main() -> None:
         help='cast restored params (bfloat16 halves HBM — required to '
         'fit 7B-class models on one v5e chip)')
     args = parser.parse_args()
+    if args.max_prompt_len < 0:
+        # A negative cap would 413 every request while /health stays
+        # green — refuse at startup instead of serving a dead replica.
+        parser.error(f'--max-prompt-len must be >= 0, '
+                     f'got {args.max_prompt_len}')
 
     import dataclasses
     import jax
@@ -149,8 +174,10 @@ def main() -> None:
         logger.warning('no --checkpoint given: serving RANDOM-INIT params '
                        '(demo mode)')
         params = init_params(model, jax.random.PRNGKey(0))['params']
-    engine = DecodeEngine(model, params,
-                          EngineConfig(n_slots=args.n_slots, mesh=mesh))
+    engine = DecodeEngine(
+        model, params,
+        EngineConfig(n_slots=args.n_slots, mesh=mesh,
+                     max_prompt_len=args.max_prompt_len or None))
     # Compile every prefill shape before taking traffic — a mid-burst
     # XLA compile would stall the whole decode batch for seconds.
     engine.prewarm()

@@ -235,6 +235,11 @@ class Attention(nn.Module):
         caller-supplied per-slot `positions` [B, S] — there is no shared
         index, so a continuous-batching engine can run heterogeneous slot
         lengths in one batch (each slot writes at its own position).
+        Against an existing cache, S == 1 is the decode step and S > 1
+        is a CHUNK of a long prompt's prefill: the chunk's K/V land at
+        their absolute positions and q attends over the full cache
+        (earlier chunks + itself), so prompts longer than any single
+        dispatch accumulate chunk by chunk.
 
         Invariant that makes bucket-padded prefill safe: every step
         attends only k_pos <= q_pos, writes at q_pos, and inserts
@@ -265,15 +270,30 @@ class Attention(nn.Module):
             cv.value = jax.lax.dynamic_update_slice(
                 cv.value, v, (0, 0, 0, 0))
             return k, v, attn_lib.mha_reference(q, k, v, causal=True)
-        # Steady state (S == 1 per slot): scatter-write each slot's k/v
-        # at its own position.  A true scatter (not a one-hot blend —
-        # that reads+writes the whole cache and double-buffers it as an
-        # HLO temp inside the decode scan, ~2x cache HBM; scatter
-        # updates one row in place under donation).
-        pos = positions[:, 0]                                   # [B]
-        b_idx = jnp.arange(b)
-        ck.value = ck.value.at[b_idx, :, pos, :].set(k[:, :, 0, :])
-        cv.value = cv.value.at[b_idx, :, pos, :].set(v[:, :, 0, :])
+        if q.shape[2] > 1:
+            # Chunked prefill (S > 1 against an existing cache): one
+            # fixed-size chunk of a long prompt lands at its absolute
+            # positions, then attends over the whole cache — earlier
+            # chunks' K/V plus itself, causally.  Position-scatter (not
+            # dynamic_update_slice, which CLAMPS the start index and
+            # would silently overwrite earlier rows if a padded chunk
+            # ran past max_len; out-of-range scatter updates drop).
+            b_col = jnp.arange(b)[:, None]                     # [B, 1]
+            ck.value = ck.value.at[b_col, :, positions, :].set(
+                k.transpose(0, 2, 1, 3))
+            cv.value = cv.value.at[b_col, :, positions, :].set(
+                v.transpose(0, 2, 1, 3))
+        else:
+            # Steady state (S == 1 per slot): scatter-write each slot's
+            # k/v at its own position.  A true scatter (not a one-hot
+            # blend — that reads+writes the whole cache and
+            # double-buffers it as an HLO temp inside the decode scan,
+            # ~2x cache HBM; scatter updates one row in place under
+            # donation).
+            pos = positions[:, 0]                               # [B]
+            b_idx = jnp.arange(b)
+            ck.value = ck.value.at[b_idx, :, pos, :].set(k[:, :, 0, :])
+            cv.value = cv.value.at[b_idx, :, pos, :].set(v[:, :, 0, :])
         k_all, v_all = ck.value, cv.value
         k_pos = jnp.arange(max_len)[None, :]
         out = attn_lib.mha_reference(

@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from skypilot_tpu.parallel.mesh import shard_map_compat
+
 
 def stack_stage_params(per_stage_params: list) -> Any:
     """[stage0_tree, stage1_tree, ...] -> one tree with leading stage
@@ -69,7 +71,7 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        shard_map_compat, mesh=mesh,
         in_specs=(P('pipeline'), P()),
         out_specs=P(),
         check_vma=False)

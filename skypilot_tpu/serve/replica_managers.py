@@ -41,6 +41,7 @@ ENV_REPLICA_PORT = 'SKYTPU_SERVE_REPLICA_PORT'
 ENV_REPLICA_ID = 'SKYTPU_SERVE_REPLICA_ID'
 ENV_SERVICE_NAME = 'SKYTPU_SERVE_SERVICE_NAME'
 ENV_REPLICA_TENSOR = 'SKYTPU_SERVE_TENSOR'
+ENV_REPLICA_MAX_PROMPT = 'SKYTPU_SERVE_MAX_PROMPT_LEN'
 
 
 class ReplicaManager:
@@ -130,6 +131,10 @@ class ReplicaManager:
             # The inference server reads this as its --tensor default:
             # the replica's engine shards over that many chips.
             envs[ENV_REPLICA_TENSOR] = str(self.spec.tensor_parallel)
+        if self.spec.max_prompt_len is not None:
+            # --max-prompt-len default: admission cap for long prompts
+            # (chunked prefill serves anything up to the model limit).
+            envs[ENV_REPLICA_MAX_PROMPT] = str(self.spec.max_prompt_len)
         task.update_envs(envs)
         res = task.any_resources
         overrides = {}

@@ -40,6 +40,12 @@ class ServiceSpec:
     # inference server shards weights/KV cache over this many chips
     # (reaches the workload as SKYTPU_SERVE_TENSOR; 1 = single-chip).
     tensor_parallel: int = 1
+    # Longest admissible prompt per request (tokens).  None: the model
+    # limit (max_seq_len - 1) — chunked prefill makes anything up to
+    # that servable.  Reaches the workload as
+    # SKYTPU_SERVE_MAX_PROMPT_LEN (the inference server's
+    # --max-prompt-len default).
+    max_prompt_len: Optional[int] = None
 
     @classmethod
     def from_yaml_config(cls, config: Dict[str, Any]) -> 'ServiceSpec':
@@ -62,13 +68,17 @@ class ServiceSpec:
                 'service: give either `replicas` (fixed) or '
                 '`replica_policy` (autoscaling), not both')
         tensor_parallel = int(config.get('tensor_parallel', 1))
+        max_prompt_raw = config.get('max_prompt_len')
+        max_prompt_len = (int(max_prompt_raw)
+                          if max_prompt_raw is not None else None)
         if policy is None:
             n = int(fixed if fixed is not None else 1)
             return cls(readiness_probe=probe, min_replicas=n,
                        max_replicas=None, target_qps_per_replica=None,
                        load_balancing_policy=config.get(
                            'load_balancing_policy', 'least_load'),
-                       tensor_parallel=tensor_parallel)
+                       tensor_parallel=tensor_parallel,
+                       max_prompt_len=max_prompt_len)
         min_r = int(policy.get('min_replicas', 1))
         max_r = policy.get('max_replicas')
         target_qps = policy.get('target_qps_per_replica')
@@ -102,6 +112,7 @@ class ServiceSpec:
             base_ondemand_fallback_replicas=int(
                 policy.get('base_ondemand_fallback_replicas', 0)),
             tensor_parallel=tensor_parallel,
+            max_prompt_len=max_prompt_len,
         )
 
     def to_yaml_config(self) -> Dict[str, Any]:
@@ -136,6 +147,8 @@ class ServiceSpec:
         out['load_balancing_policy'] = self.load_balancing_policy
         if self.tensor_parallel != 1:
             out['tensor_parallel'] = self.tensor_parallel
+        if self.max_prompt_len is not None:
+            out['max_prompt_len'] = self.max_prompt_len
         return out
 
     @property

@@ -58,6 +58,13 @@ _HELP = {
         '((finish - first token) / (tokens - 1))',
     'skytpu_engine_prefill_tokens_total':
         'Prompt tokens prefilled into decode slots',
+    'skytpu_engine_prefill_chunks_total':
+        'Chunked-prefill dispatches (fixed-size chunks of long prompts '
+        'interleaved with decode calls)',
+    'skytpu_engine_queued_prefill_tokens':
+        'Prompt tokens accepted but not yet prefilled (queued requests '
+        'plus the un-prefilled remainder of an in-progress chunked '
+        'prompt) — the long-prompt backlog per replica',
     'skytpu_engine_decode_tokens_total':
         'Tokens emitted by the decode loop',
     'skytpu_engine_requests_total':
@@ -99,8 +106,12 @@ _HELP = {
 DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
                    5.0, 10.0, 30.0, 60.0)
 _BUCKETS: Dict[str, Tuple[float, ...]] = {
+    # Upper buckets sized for chunked long-context prefills on a
+    # saturated engine (a 128k prefill interleaves with decode over
+    # many loop iterations — TTFT can legitimately reach minutes).
     'skytpu_engine_ttft_seconds':
-        (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0),
+        (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+         60.0, 120.0),
     'skytpu_engine_inter_token_seconds':
         (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
          0.5, 1.0),

@@ -13,7 +13,11 @@ The pattern, TPU-first:
   log-prob loss whose forward is a standard teacher-forced pass over
   [prompt + sampled] — one big MXU matmul batch, no per-token Python;
 - advantages are plain host arrays (reward whitening happens host-side
-  where reward functions live).
+  where reward functions live);
+- SWAP with engine.update_params WITHOUT draining: the learner's tree
+  stages into the engine's committed layouts and installs at the decode
+  loop's next dispatch boundary (double-buffered), so the
+  rollout/update alternation never stops serving.
 
 This is deliberately the PRIMITIVE layer: PPO ratios/KL penalties
 compose on top by passing `ref_logprobs`; the example recipe
@@ -109,7 +113,10 @@ def rollout(engine, prompts: List[List[int]], max_new_tokens: int,
     reqs = [engine.submit(p, max_new_tokens) for p in prompts]
     while any(r.finished_at is None for r in reqs):
         engine.step_pipelined()
-    engine.drain()          # retire-lag garbage call; engine now idle
+    # No drain: the retire-lag call left in flight is garbage the next
+    # rollout's first step discards, and update_params no longer needs
+    # an idle engine — the learner's new tree installs at the next
+    # dispatch boundary while serving continues (double-buffered swap).
     sampled = [r.tokens() for r in reqs]
     rewards = [reward_fn(p, s) for p, s in zip(prompts, sampled)]
     prompt_lens = np.asarray([len(p) for p in prompts], np.int32)

@@ -92,6 +92,10 @@ _SERVICE_SCHEMA: Dict[str, Any] = {
         # Tensor-parallel degree for each replica's decode engine
         # (plumbed to the workload as SKYTPU_SERVE_TENSOR).
         'tensor_parallel': {'type': 'integer', 'minimum': 1},
+        # Admission cap for prompt length, in tokens (plumbed to the
+        # workload as SKYTPU_SERVE_MAX_PROMPT_LEN; omitted = the model
+        # limit — chunked prefill serves prompts up to max_seq_len - 1).
+        'max_prompt_len': {'type': 'integer', 'minimum': 1},
     },
 }
 

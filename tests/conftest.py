@@ -51,6 +51,7 @@ _MODEL_MODULES = {
     'test_models_train', 'test_models_zoo', 'test_moe_pipeline',
     'test_ops', 'test_inference', 'test_multislice',
     'test_placement_validate', 'test_rl', 'test_serve_sharded',
+    'test_serve_chunked',
 }
 _E2E_MODULES = {
     'test_agent_events', 'test_api_server', 'test_authentication',

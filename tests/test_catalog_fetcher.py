@@ -94,3 +94,49 @@ def test_fetcher_zones_come_from_bundled_not_invented(billing_fixture):
     bundled = pd.read_csv(os.path.join(common._BUNDLED_DIR,
                                        'gcp_tpus.csv'))
     assert set(df['zone']) <= set(bundled['zone'])
+
+
+def test_fixture_recording_date_threads_into_meta(billing_fixture,
+                                                  monkeypatch):
+    """A fixture replay must stamp the RECORDING date into the written
+    .meta.json — not the replay time — so catalog staleness tracks the
+    data's true age, and the staleness check trips on an old
+    recording."""
+    import datetime
+    assert fetch_gcp.main() == 0
+    want = datetime.datetime.strptime('2026-07-28', '%Y-%m-%d').replace(
+        tzinfo=datetime.timezone.utc).timestamp()
+    assert fetch_gcp.fixture_recorded_at() == pytest.approx(want)
+    for name in ('gcp_tpus.csv', 'gcp_vms.csv'):
+        meta = json.load(open(os.path.join(common.catalog_override_dir(),
+                                           name + '.meta.json'),
+                              encoding='utf-8'))
+        assert meta['generated_at'] == pytest.approx(want)
+        # catalog_staleness reads the override meta (it resolves the
+        # override path) and ages from the recording date.
+        staleness = common.catalog_staleness(name)
+        assert staleness['age_days'] is not None
+        import time
+        expect_age = (time.time() - want) / 86400.0
+        assert staleness['age_days'] == pytest.approx(expect_age, abs=0.2)
+    # The check TRIPS once the recording outlives the threshold.
+    monkeypatch.setattr(common, 'STALENESS_DAYS', 0.0)
+    assert common.catalog_staleness('gcp_tpus.csv')['stale'] is True
+
+
+def test_fixture_without_provenance_stamps_now(billing_fixture, tmp_path,
+                                               monkeypatch):
+    """A bare page-list fixture (no recorded_at) keeps the old
+    behavior: the sidecar stamps the fetch time."""
+    import time
+    bare = tmp_path / 'bare_skus.json'
+    pages = json.load(open(FIXTURE, encoding='utf-8'))['pages']
+    bare.write_text(json.dumps(pages))
+    monkeypatch.setenv('SKYTPU_BILLING_FIXTURE', str(bare))
+    assert fetch_gcp.fixture_recorded_at() is None
+    t0 = time.time()
+    assert fetch_gcp.main() == 0
+    meta = json.load(open(os.path.join(common.catalog_override_dir(),
+                                       'gcp_tpus.csv.meta.json'),
+                          encoding='utf-8'))
+    assert meta['generated_at'] >= t0 - 1

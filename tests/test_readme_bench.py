@@ -10,6 +10,7 @@ the exact textual form the README uses ("47.6% MFU", "350.9 out-tok/s",
 import glob
 import json
 import os
+import re
 
 import pytest
 
@@ -58,8 +59,36 @@ def test_readme_perf_claims_track_latest_bench():
         'serve TPOT':
             f"TPOT {detail['serve']['tpot_median_ms']:.1f} ms",
     }
+    # Newer-scenario claims pin only once the artifact carries them
+    # (and the README may not invent them before it does — see the
+    # guard test below): the saturated-TTFT number from the chunked-
+    # prefill scenario.
+    saturated = detail['serve'].get('saturated')
+    if saturated and saturated.get('ttft_saturated_ms') is not None:
+        claims['saturated TTFT'] = (
+            f"saturated TTFT {saturated['ttft_saturated_ms']:.1f} ms")
     missing = {name: text for name, text in claims.items()
                if text not in readme}
     assert not missing, (
         f'README perf claims drifted from the latest bench artifact '
         f'{path}: expected these exact strings in README.md: {missing}')
+
+
+def test_readme_makes_no_unmeasured_saturated_ttft_claim():
+    """Drift guard, other direction: a numeric saturated-TTFT claim in
+    the README must come from the latest bench artifact, not be
+    invented ahead of it."""
+    path, parsed = _latest_bench()
+    saturated = (parsed['detail'].get('serve') or {}).get('saturated')
+    with open(os.path.join(_ROOT, 'README.md'), encoding='utf-8') as f:
+        readme = ' '.join(f.read().split())
+    found = re.findall(r'saturated TTFT ([0-9.]+) ms', readme)
+    if not saturated or saturated.get('ttft_saturated_ms') is None:
+        assert not found, (
+            f'README claims a saturated TTFT ({found}) but the latest '
+            f'bench artifact {path} has no saturated-TTFT scenario')
+    else:
+        want = f"{saturated['ttft_saturated_ms']:.1f}"
+        assert all(v == want for v in found), (
+            f'README saturated-TTFT claim {found} drifted from '
+            f'{path}: expected {want}')

@@ -1,0 +1,416 @@
+"""Chunked prefill + in-flight weight swap (engine long-context path).
+
+Parity contract: a prompt longer than the largest prefill bucket —
+admitted via the chunked path (scratch cache, one chunk per loop
+iteration) — must produce greedy output token-identical to the SAME
+prompt through the fused single-dispatch path (an engine whose largest
+bucket swallows it whole), single-device and under the virtual tensor=2
+mesh.  Float32 compute for the cross-program comparisons, per the
+test_serve_sharded.py precedent (bf16's one-ULP fusion-order noise
+flips argmax on tiny random weights).
+
+Swap contract: update_params with active slots and calls in flight —
+no drain, no dropped request, and the first decode call dispatched
+after the install samples from the new weights.
+"""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.inference.engine import DecodeEngine, EngineConfig
+from skypilot_tpu.models.llama import LLAMA_CONFIGS, Llama, init_params
+from skypilot_tpu.parallel.mesh import build_serve_mesh
+
+CFG = dataclasses.replace(LLAMA_CONFIGS['tiny'], dtype=jnp.float32)
+_PROMPT_RNG = np.random.default_rng(7)
+
+
+@pytest.fixture(scope='module')
+def params():
+    return init_params(Llama(CFG), jax.random.PRNGKey(0))['params']
+
+
+def make_engine(params, tensor=1, **overrides):
+    mesh = None
+    if tensor > 1:
+        mesh = build_serve_mesh(tensor, n_heads=CFG.n_heads,
+                                n_kv_heads=CFG.n_kv_heads)
+    kw = dict(n_slots=2, prefill_buckets=(8, 16), steps_per_call=3)
+    kw.update(overrides)
+    return DecodeEngine(Llama(CFG, mesh), params,
+                        EngineConfig(mesh=mesh, **kw))
+
+
+def run_to_completion(engine, reqs, max_steps=2000, step='step'):
+    fn = getattr(engine, step)
+    for _ in range(max_steps):
+        fn()
+        if all(r.finished_at is not None for r in reqs):
+            return
+    raise AssertionError('requests did not finish')
+
+
+def fused_reference(params, prompt, n_new):
+    """The single-dispatch path: an engine whose largest bucket holds
+    the whole prompt (max_seq_len 128 admits buckets up to 128)."""
+    engine = make_engine(params, prefill_buckets=(8, 16, 128))
+    assert len(prompt) <= 128
+    req = engine.submit(prompt, n_new)
+    run_to_completion(engine, [req])
+    return req.tokens()
+
+
+def prompt_of(n):
+    return _PROMPT_RNG.integers(1, CFG.vocab_size, n).tolist()
+
+
+# ----- parity ----------------------------------------------------------------
+@pytest.mark.parametrize('plen', [20, 45, 120])   # 2, 3 and 8 chunks of 16
+def test_chunked_matches_fused_single_device(params, plen):
+    prompt = prompt_of(plen)
+    want = fused_reference(params, prompt, 6)
+    engine = make_engine(params)
+    assert plen > engine.cfg.prefill_buckets[-1]   # really chunked
+    req = engine.submit(prompt, 6)
+    run_to_completion(engine, [req])
+    assert req.tokens() == want
+
+
+@pytest.mark.parametrize('plen', [20, 120])
+def test_chunked_matches_fused_tensor2(params, plen):
+    prompt = prompt_of(plen)
+    want = fused_reference(params, prompt, 6)
+    engine = make_engine(params, tensor=2)
+    req = engine.submit(prompt, 6)
+    run_to_completion(engine, [req])
+    assert req.tokens() == want
+
+
+def test_chunked_pipelined_mixed_traffic(params):
+    """Long and short prompts interleaved through the pipelined
+    scheduler: every request completes with its exact token budget,
+    the long ones token-identical to the fused reference, and two runs
+    agree (no scheduling nondeterminism)."""
+    long1, long2 = prompt_of(30), prompt_of(50)
+    shorts = [prompt_of(3), prompt_of(12), prompt_of(7)]
+    want1 = fused_reference(params, long1, 8)
+    want2 = fused_reference(params, long2, 5)
+
+    def run():
+        engine = make_engine(params)
+        r1 = engine.submit(long1, 8)
+        rs = [engine.submit(p, 6) for p in shorts[:2]]
+        engine.step_pipelined()
+        r2 = engine.submit(long2, 5)
+        rs.append(engine.submit(shorts[2], 6))
+        run_to_completion(engine, [r1, r2] + rs, step='step_pipelined')
+        return [r.tokens() for r in (r1, r2)], [r.tokens() for r in rs]
+
+    first = run()
+    (got1, got2), short_toks = first
+    assert got1 == want1 and got2 == want2
+    assert [len(t) for t in short_toks] == [6, 6, 6]
+    assert run() == first
+
+
+def test_chunked_slot_reuse_no_kv_leak(params):
+    """A chunk-prefilled request admitted into a reused slot must not
+    see the previous occupant's KV (the final-chunk insert overwrites
+    the slot's whole cache)."""
+    engine = make_engine(params, n_slots=1)
+    first = engine.submit(prompt_of(40), 5)
+    run_to_completion(engine, [first])
+    prompt = prompt_of(25)
+    want = fused_reference(params, prompt, 5)
+    second = engine.submit(prompt, 5)
+    run_to_completion(engine, [second])
+    assert second.tokens() == want
+
+
+def test_chunked_up_to_max_seq_len(params):
+    """The admission ceiling is the CACHE, not the bucket set: a
+    max_seq_len-1 prompt is admissible and generates its one token."""
+    engine = make_engine(params)
+    assert engine.max_prompt_len == CFG.max_seq_len - 1
+    req = engine.submit(prompt_of(CFG.max_seq_len - 1), 10)
+    assert req.max_new_tokens == 1          # clamped to the cache
+    run_to_completion(engine, [req])
+    assert len(req.tokens()) == 1
+
+
+def test_final_insert_not_starved_by_short_traffic(params):
+    """Sustained short-prompt traffic must not starve a long prompt's
+    final chunk-insert: once the final chunk is pending, admission
+    reserves a slot for it, so the long prompt finishes ahead of
+    shorts that were queued behind it (n_slots=1 makes the contention
+    total — without the reservation the insert waits for the whole
+    short queue to drain)."""
+    engine = make_engine(params, n_slots=1)
+    prompt = prompt_of(40)
+    want = fused_reference(params, prompt, 5)
+    long_req = engine.submit(prompt, 5)
+    shorts = [engine.submit(prompt_of(4), 5) for _ in range(8)]
+    run_to_completion(engine, [long_req] + shorts, step='step_pipelined')
+    assert long_req.tokens() == want
+    assert long_req.finished_at < max(s.finished_at for s in shorts)
+
+
+# ----- zero recompiles -------------------------------------------------------
+def test_zero_recompiles_mixed_chunked_short_traffic(params):
+    """After one warmup pass over every shape, mixed chunked/short
+    traffic must never add a compiled-call cache entry — chunk offsets
+    and lengths are traced values, not shapes, on both the single-
+    device and the sharded engine."""
+    for tensor in (1, 2):
+        engine = make_engine(params, tensor=tensor)
+        if tensor > 1:
+            engine.prewarm()    # mesh path: executes every shape
+        warm = [engine.submit(prompt_of(40), 4),    # chunks, rem 8 -> b8
+                engine.submit(prompt_of(35), 4),    # chunks, rem 3 -> b8
+                engine.submit(prompt_of(28), 4),    # chunk, rem 12 -> b16
+                engine.submit(prompt_of(5), 4),     # fused bucket 8
+                engine.submit(prompt_of(12), 4)]    # fused bucket 16
+        run_to_completion(engine, warm, step='step_pipelined')
+        engine.drain()
+        fns = [engine._decode, engine._prefill_insert,
+               engine._prefill_chunk, engine._chunk_insert,
+               engine._scratch_fn]
+        sizes = [f._cache_size() for f in fns]
+        traffic = [engine.submit(prompt_of(55), 5),  # 3 chunks, rem 7
+                   engine.submit(prompt_of(44), 5),  # rem 12 -> bucket 16
+                   engine.submit(prompt_of(7), 5),
+                   engine.submit(prompt_of(16), 5)]
+        run_to_completion(engine, traffic, step='step_pipelined')
+        engine.drain()
+        assert [f._cache_size() for f in fns] == sizes, f'tensor={tensor}'
+
+
+# ----- in-flight weight swap -------------------------------------------------
+_SENTINELS = (100, 200)
+
+
+def _sentinel_params(params):
+    """A tree whose lm_head can only ever argmax to one of two
+    sentinel tokens, WHATEVER the hidden state (and therefore whatever
+    K/V the cache accumulated under the old weights): every column is
+    zero except +-5 constant columns at the sentinels, so the logits
+    are (5*sum(h), -5*sum(h), 0, ...).  Greedy output under these
+    weights is a cache-independent fingerprint of the swap."""
+    import flax.linen as nn
+    params = nn.meta.unbox(params)
+    kernel = np.zeros(
+        np.asarray(params['lm_head']['kernel']).shape, np.float32)
+    kernel[:, _SENTINELS[0]] = 5.0
+    kernel[:, _SENTINELS[1]] = -5.0
+    return {k: ({'kernel': jnp.asarray(kernel)} if k == 'lm_head'
+                else params[k]) for k in params}
+
+
+def test_update_params_in_flight_next_call_uses_new_weights(params):
+    """Sync-step control: swap mid-request; the very next decode call
+    (dispatched after the install) must sample from the NEW weights.
+    The sentinel lm_head makes that detectable without a reference
+    forward: post-install tokens can ONLY be sentinels, and pre-install
+    tokens (random weights) are essentially never all sentinels."""
+    engine = make_engine(params, n_slots=1, steps_per_call=2)
+    prompt = prompt_of(5)
+    req = engine.submit(prompt, 12)
+    for _ in range(3):
+        engine.step()
+    emitted_before = req.emitted
+    assert emitted_before and req.finished_at is None
+    engine.update_params(_sentinel_params(params))
+    engine.step()                      # first call after the install
+    run_to_completion(engine, [req])
+    toks = req.tokens()
+    assert len(toks) == 12             # never dropped, full budget
+    assert set(toks[emitted_before:]) <= set(_SENTINELS), \
+        'a post-install token was sampled from the old weights'
+    assert not set(toks[:emitted_before]) <= set(_SENTINELS)
+
+
+def test_update_params_in_flight_chunked_and_sharded(params):
+    """The swap composes with a chunked prefill in progress and with
+    the tensor=2 mesh: nothing is dropped, serving continues, and the
+    installed tree lands in the committed shardings."""
+    engine = make_engine(params, tensor=2)
+    long_req = engine.submit(prompt_of(60), 8)
+    short_req = engine.submit(prompt_of(4), 8)
+    engine.step_pipelined()            # chunk 1 + short admission in flight
+    new_params = jax.tree.map(
+        lambda x: x * 1.03 if x.dtype == np.float32 else x, params)
+    engine.update_params(new_params)
+    run_to_completion(engine, [long_req, short_req],
+                      step='step_pipelined')
+    assert len(long_req.tokens()) == 8
+    assert len(short_req.tokens()) == 8
+    kernel = engine.params['layer_0']['attn']['q_proj']['kernel']
+    assert len(kernel.sharding.device_set) == 2
+
+
+def test_update_params_continuous_emission_across_swaps(params):
+    """Rolling refresh under the threaded loop: tokens keep flowing
+    while update_params fires repeatedly — no request blocks, none is
+    dropped."""
+    engine = make_engine(params, n_slots=2, steps_per_call=2)
+    engine.start()
+    try:
+        reqs = [engine.submit(prompt_of(20), 20),
+                engine.submit(prompt_of(6), 20)]
+        trees = [jax.tree.map(
+            lambda x, s=s: x * s if x.dtype == np.float32 else x, params)
+            for s in (1.01, 1.02, 1.03)]
+        for tree in trees:
+            engine.update_params(tree)
+        outs = [r.tokens() for r in reqs]
+    finally:
+        engine.stop()
+    assert engine.healthy
+    assert [len(o) for o in outs] == [20, 20]
+
+
+# ----- admission errors ------------------------------------------------------
+def test_admission_rejects_beyond_max_seq_len(params):
+    engine = make_engine(params)
+    with pytest.raises(ValueError, match='max_prompt_len'):
+        engine.submit(prompt_of(CFG.max_seq_len), 4)
+    with pytest.raises(ValueError, match=str(CFG.max_seq_len - 1)):
+        engine.submit(prompt_of(500), 4)
+
+
+def test_admission_respects_max_prompt_len_knob(params):
+    engine = make_engine(params, max_prompt_len=32)
+    assert engine.max_prompt_len == 32
+    with pytest.raises(ValueError, match='max_prompt_len 32'):
+        engine.submit(prompt_of(33), 4)
+    req = engine.submit(prompt_of(32), 4)       # at the cap: admitted
+    run_to_completion(engine, [req])
+    assert len(req.tokens()) == 4
+
+
+def test_http_server_413_carries_limit(params):
+    """The inference server turns an over-limit prompt into a clear
+    4xx carrying the limit — not a 500, not a silent hang."""
+    import asyncio
+    from aiohttp.test_utils import TestClient, TestServer
+    from skypilot_tpu.inference.server import build_app
+
+    engine = make_engine(params, max_prompt_len=16)
+    engine.start()
+
+    async def drive():
+        client = TestClient(TestServer(build_app(engine)))
+        await client.start_server()
+        try:
+            r = await client.post(
+                '/v1/completions',
+                json={'prompt_ids': list(range(1, 40)), 'max_tokens': 4})
+            assert r.status == 413
+            body = await r.json()
+            assert body['max_prompt_len'] == 16
+            assert 'max_prompt_len 16' in body['error']
+            # An admissible long prompt (chunked) still serves.
+            r2 = await client.post(
+                '/v1/completions',
+                json={'prompt_ids': list(range(1, 14)), 'max_tokens': 3})
+            assert r2.status == 200
+            assert len((await r2.json())['ids']) == 3
+        finally:
+            await client.close()
+
+    try:
+        asyncio.new_event_loop().run_until_complete(drive())
+    finally:
+        engine.stop()
+
+
+# ----- metrics ---------------------------------------------------------------
+def test_chunk_counter_and_backlog_gauge(params):
+    from skypilot_tpu.server import metrics
+    metrics.reset_for_tests()
+    try:
+        engine = make_engine(params)
+        req = engine.submit(prompt_of(40), 4)     # 2 chunks + final 8
+        # Backlog gauge shows the accepted-but-unprefilled tokens.
+        engine._sample_gauges(0)
+        text = metrics.render()
+        assert 'skytpu_engine_queued_prefill_tokens 40.0' in text
+        run_to_completion(engine, [req])
+        engine._sample_gauges(0)
+        text = metrics.render()
+        assert 'skytpu_engine_prefill_chunks_total 3.0' in text
+        assert 'skytpu_engine_queued_prefill_tokens 0.0' in text
+        # All 40 prompt tokens were counted as prefilled, chunk by chunk.
+        assert 'skytpu_engine_prefill_tokens_total 40.0' in text
+    finally:
+        metrics.reset_for_tests()
+
+
+# ----- serve-spec knob plumbing ----------------------------------------------
+def test_service_spec_max_prompt_len_roundtrip():
+    from skypilot_tpu.serve.service_spec import ServiceSpec
+    spec = ServiceSpec.from_yaml_config({
+        'readiness_probe': '/health',
+        'replicas': 2,
+        'max_prompt_len': 9000,
+    })
+    assert spec.max_prompt_len == 9000
+    out = spec.to_yaml_config()
+    assert out['max_prompt_len'] == 9000
+    assert ServiceSpec.from_yaml_config(out).max_prompt_len == 9000
+    # Default stays None and is omitted from the round trip.
+    plain = ServiceSpec.from_yaml_config({'readiness_probe': '/'})
+    assert plain.max_prompt_len is None
+    assert 'max_prompt_len' not in plain.to_yaml_config()
+
+
+def test_replica_task_env_carries_max_prompt_len():
+    """The knob reaches the replica workload as
+    SKYTPU_SERVE_MAX_PROMPT_LEN (the inference server's
+    --max-prompt-len default)."""
+    from skypilot_tpu.serve import replica_managers
+    from skypilot_tpu.serve.service_spec import ServiceSpec
+    from skypilot_tpu.task import Task
+
+    task = Task('svc', run='echo serve')
+    spec = ServiceSpec.from_yaml_config({
+        'readiness_probe': '/health', 'replicas': 1,
+        'max_prompt_len': 4096})
+    mgr = replica_managers.ReplicaManager.__new__(
+        replica_managers.ReplicaManager)
+    mgr.task = task
+    mgr.spec = spec
+    mgr.service_name = 'svc'
+    rt = mgr._replica_task(0, 8200, None, False)
+    assert rt.envs[replica_managers.ENV_REPLICA_MAX_PROMPT] == '4096'
+    # Unset: the env is absent and the server falls back to the model
+    # limit.
+    mgr.spec = ServiceSpec.from_yaml_config(
+        {'readiness_probe': '/health', 'replicas': 1})
+    rt2 = mgr._replica_task(0, 8200, None, False)
+    assert replica_managers.ENV_REPLICA_MAX_PROMPT not in rt2.envs
+
+
+# ----- saturation soak (slow tier) -------------------------------------------
+@pytest.mark.slow
+def test_saturated_soak_long_prompts_interleave(params):
+    """Soak: a saturated decode batch plus a stream of long prompts
+    through the threaded loop.  Every request completes with its full
+    budget; the engine stays healthy; decode was never starved (short
+    requests submitted after a long prompt finish well before it)."""
+    engine = make_engine(params, n_slots=4, steps_per_call=2)
+    engine.start()
+    try:
+        reqs = []
+        for round_i in range(6):
+            reqs.append(engine.submit(prompt_of(60 + round_i), 10))
+            for _ in range(3):
+                reqs.append(engine.submit(prompt_of(5), 10))
+        outs = [r.tokens() for r in reqs]
+    finally:
+        engine.stop()
+    assert engine.healthy
+    assert all(len(o) == 10 for o in outs)
