@@ -349,6 +349,40 @@ def bench_saturated_ttft(on_tpu: bool) -> dict:
     }
 
 
+def bench_slo_ramp(plateau_ticks: int = 12) -> dict:
+    """SLO-aware vs QPS-only autoscaling under a synthetic traffic ramp
+    (virtual replicas, virtual time — hermetic and chip-free).
+
+    The setup is the one that breaks QPS autoscaling in production: the
+    operator's `target_qps_per_replica` (8) over-states the replicas'
+    true batching knee (2 qps — e.g. calibrated on short prompts, then
+    traffic shifted long), so the QPS policy under-provisions at the
+    ramp top while the SLO policy reads the p95 TPOT users actually see
+    from the federated histograms and scales until the target holds.
+    Both policies get the same replica budget (max 8) and ideal, instant
+    provisioning — the comparison isolates DECISION quality.  Reported:
+    requests-weighted p95 TPOT over the plateau tail for each policy,
+    against the 15 ms target.
+    """
+    from skypilot_tpu.serve import slo_sim
+
+    # Scenario constants + driver live in slo_sim so this bench and its
+    # load-tier test twin (tests/test_load.py) provably run the SAME
+    # experiment.
+    target_tpot_ms = slo_sim.DEFAULT_TARGET_TPOT_MS
+    ramp = slo_sim.default_ramp(plateau_ticks)
+    out: dict = {'target_tpot_ms': target_tpot_ms,
+                 'peak_qps': ramp[-1], 'ticks': len(ramp)}
+    for key, slo in (('slo', True), ('qps', False)):
+        history = slo_sim.run_policy(slo, ramp)
+        out[f'p95_tpot_ms_{key}'] = round(
+            slo_sim.requests_weighted_p95(history, last_n_ticks=4), 2)
+        out[f'final_replicas_{key}'] = history[-1][1]
+    out['slo_meets_target'] = out['p95_tpot_ms_slo'] <= target_tpot_ms
+    out['qps_meets_target'] = out['p95_tpot_ms_qps'] <= target_tpot_ms
+    return out
+
+
 def bench_launch() -> dict:
     """Control-plane overhead: launch -> agent READY -> rank-0 start.
 
@@ -454,6 +488,9 @@ def main() -> None:
     jax.clear_caches()
     gc.collect()
     serve['saturated'] = bench_saturated_ttft(on_tpu)
+    # SLO-vs-QPS autoscaling comparison: pure-CPU virtual-replica
+    # simulation (no device state to manage).
+    serve['slo_ramp'] = bench_slo_ramp()
     print(json.dumps({
         'metric': 'llama_train_mfu_single_chip',
         'value': train['mfu_pct'],

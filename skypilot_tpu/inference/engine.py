@@ -626,6 +626,15 @@ class DecodeEngine:
             limit = min(limit, self.cfg.max_prompt_len)
         return limit
 
+    @property
+    def queued_prefill_tokens(self) -> int:
+        """Prompt tokens accepted but not yet prefilled — the same value
+        the skytpu_engine_queued_prefill_tokens gauge exports.  Cheap
+        (one int read, no device sync): the inference server stamps it
+        on every response header so the serve LB's admission control
+        sees the backlog without an extra round trip."""
+        return max(0, self._queued_tokens)
+
     def submit(self, prompt_ids: List[int],
                max_new_tokens: int = 64) -> Request:
         limit = self.max_prompt_len
@@ -911,7 +920,7 @@ class DecodeEngine:
         # host-side perf_counter stamps only, no device sync.
         if req.first_token_at is not None and req.emitted > 1:
             metrics_lib.observe_hist(
-                'skytpu_engine_inter_token_seconds',
+                metrics_lib.ENGINE_TPOT_FAMILY,
                 (req.finished_at - req.first_token_at) /
                 (req.emitted - 1))
         req.out.put(None)
@@ -1034,7 +1043,7 @@ class DecodeEngine:
         # Long-prompt backlog: tokens accepted but not yet prefilled
         # (the LB federates this per replica, so a scrape sees where
         # chunked prefills are queueing up).
-        metrics_lib.set_gauge('skytpu_engine_queued_prefill_tokens',
+        metrics_lib.set_gauge(metrics_lib.QUEUED_PREFILL_TOKENS_FAMILY,
                               float(max(sample[2], 0)))
 
     def step(self) -> int:
@@ -1139,7 +1148,7 @@ class DecodeEngine:
                 slot.first_pending = False
                 slot.request.first_token_at = now
                 metrics_lib.observe_hist(
-                    'skytpu_engine_ttft_seconds',
+                    metrics_lib.ENGINE_TTFT_FAMILY,
                     now - slot.request.submitted_at)
             else:
                 start = 1                # row 0 was emitted last step

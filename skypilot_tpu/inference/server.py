@@ -19,6 +19,11 @@ launched by examples/tpu/v6e/serve-llama2-7b.yaml).  Routes:
                         prompt beyond that limit gets 413 with the
                         limit in the body.
 
+Every response carries `X-Skytpu-Queued-Prefill-Tokens` (the engine's
+queued-prefill-token backlog — same value as the gauge): the serve LB
+reads it for free on the proxy path and feeds queue-aware admission
+control and least_load routing.
+
 Text prompts use a byte-level tokenizer (token id = byte value), which is
 model-agnostic and dependency-free; real deployments pass `prompt_ids`
 from their own tokenizer.
@@ -48,8 +53,22 @@ def decode_bytes(ids: List[int]) -> str:
                                                         errors='replace')
 
 
+# Engine backlog stamped on every response: queued prefill tokens.  The
+# serve load balancer reads it for free on the proxy response path and
+# feeds queue-aware admission control + least_load routing (shared
+# constant: server/metrics.py owns the cross-process names).
+BACKLOG_HEADER = metrics_lib.BACKLOG_HEADER
+
+
 def build_app(engine: DecodeEngine) -> web.Application:
-    app = web.Application()
+
+    @web.middleware
+    async def stamp_backlog(request: web.Request, handler):
+        resp = await handler(request)
+        resp.headers[BACKLOG_HEADER] = str(engine.queued_prefill_tokens)
+        return resp
+
+    app = web.Application(middlewares=[stamp_backlog])
 
     async def health(_request):
         if not engine.healthy:

@@ -17,6 +17,7 @@ import time
 from typing import Deque, List, Optional, Tuple
 
 from skypilot_tpu.serve.service_spec import ServiceSpec
+from skypilot_tpu.server import metrics as _metrics_names
 
 # Seconds of request history the QPS estimate averages over.
 QPS_WINDOW_SECONDS = 60.0
@@ -32,6 +33,11 @@ class AutoscalerDecision:
 class Autoscaler:
     """Fixed-size policy: hold at min_replicas (spec without autoscaling)."""
 
+    # Set on policies that decide from the LB's federated /metrics
+    # exposition (the controller only pays for a scrape when the policy
+    # will read it).
+    wants_lb_scrape = False
+
     def __init__(self, spec: ServiceSpec,
                  qps_window_seconds: float = QPS_WINDOW_SECONDS) -> None:
         self.spec = spec
@@ -42,6 +48,9 @@ class Autoscaler:
     def make(cls, spec: ServiceSpec,
              decision_interval_seconds: float,
              qps_window_seconds: float = QPS_WINDOW_SECONDS) -> 'Autoscaler':
+        if spec.slo_autoscaling_enabled:
+            return SLOAutoscaler(spec, decision_interval_seconds,
+                                 qps_window_seconds)
         if spec.autoscaling_enabled:
             return RequestRateAutoscaler(spec, decision_interval_seconds,
                                          qps_window_seconds)
@@ -62,6 +71,17 @@ class Autoscaler:
         proxied-request count.  The fixed policy ignores load."""
         del total_requests
         return self.evaluate([], num_live_replicas, now)
+
+    def evaluate_scrape(self, exposition: Optional[str],
+                        total_requests: int, num_live_replicas: int,
+                        now: Optional[float] = None) -> AutoscalerDecision:
+        """Metrics-fed entry: the controller passes the LB's federated
+        /metrics text (None when the scrape failed or the policy did
+        not ask for one).  Policies that ignore latency fall through to
+        the counter path."""
+        del exposition
+        return self.evaluate_counter(total_requests, num_live_replicas,
+                                     now)
 
     def adopt_history(self, old: 'Autoscaler') -> None:
         """Carry scaling state over from the autoscaler this one
@@ -128,8 +148,18 @@ class RequestRateAutoscaler(Autoscaler):
     def record_request_count(self, total_requests: int,
                              now: Optional[float] = None) -> None:
         """Sample the LB's monotonic request counter.  Keeps one sample
-        at (or just outside) the window edge as the rate baseline."""
+        at (or just outside) the window edge as the rate baseline.
+
+        Counter-reset clamp: an LB restart zeroes its counter, so the
+        new value can be BELOW the window's samples — every prior
+        sample is then a baseline from a dead counter generation and
+        would read as a negative delta.  Drop them and treat the new
+        value as a fresh baseline (one window of 0-QPS vision beats a
+        window of garbage)."""
         now = time.time() if now is None else now
+        if self._count_samples and \
+                total_requests < self._count_samples[-1][1]:
+            self._count_samples.clear()
         self._count_samples.append((now, total_requests))
         cutoff = now - self.qps_window_seconds
         while len(self._count_samples) >= 2 and \
@@ -164,6 +194,13 @@ class RequestRateAutoscaler(Autoscaler):
     def _decide(self, qps: float,
                 num_live_replicas: int) -> AutoscalerDecision:
         desired = int(math.ceil(qps / self.spec.target_qps_per_replica))
+        return self._apply_hysteresis(desired, num_live_replicas)
+
+    def _apply_hysteresis(self, desired: int,
+                          num_live_replicas: int) -> AutoscalerDecision:
+        """Clamp `desired` to the spec bounds and commit it only after
+        it has been sustained for the up/downscale delay (counted in
+        whole decision intervals)."""
         desired = max(self.spec.min_replicas,
                       min(self.spec.max_replicas, desired))
         if desired > self.target_num_replicas:
@@ -184,3 +221,159 @@ class RequestRateAutoscaler(Autoscaler):
         return AutoscalerDecision(
             self.target_num_replicas,
             self.target_num_replicas - num_live_replicas)
+
+
+class SLOAutoscaler(RequestRateAutoscaler):
+    """Scale on p95 TTFT/TPOT measured from the LB's federated
+    histograms (ThunderServe's thesis, arXiv:2502.09334: schedule and
+    scale on per-replica latency signals, not request counts).
+
+    Decision inputs per tick, all read from ONE federated /metrics
+    scrape (the same text the dashboards scrape — no side channel):
+      - p95 TTFT and p95 TPOT over the QPS window, from per-bucket
+        deltas of skytpu_engine_ttft_seconds /
+        skytpu_engine_inter_token_seconds (metrics_math);
+      - the service-wide queued-prefill-token backlog gauge sum;
+      - the LB's monotonic request counter (passed separately) for the
+        QPS fallback — and because the LB counts SHED requests in it,
+        suppressed demand still argues for scale-up while admission
+        control protects the replicas.
+
+    Policy:
+      - scale UP (one replica per sustained violation, or more if QPS
+        demands it) when a measured p95 exceeds its target, or when the
+        backlog exceeds max_queue_tokens_per_replica x live replicas;
+      - scale DOWN only when QPS wants fewer AND the projected
+        post-scale-down p95 still meets every set target.  Projection
+        is the conservative load-proportional model p95 x live/fewer —
+        decode latency grows at least linearly in per-replica load once
+        batching saturates, so the model under-estimates headroom and
+        never green-lights a shrink the SLO cannot absorb;
+      - with NO histogram samples in the window (cold service, scrape
+        failure), behave exactly like RequestRateAutoscaler.
+    """
+
+    wants_lb_scrape = True
+
+    # Histogram families the decision reads (federated engine series;
+    # names shared with the exporter via server/metrics.py constants).
+    TTFT_FAMILY = _metrics_names.ENGINE_TTFT_FAMILY
+    TPOT_FAMILY = _metrics_names.ENGINE_TPOT_FAMILY
+    BACKLOG_FAMILY = _metrics_names.QUEUED_PREFILL_TOKENS_FAMILY
+    QUANTILE = 0.95
+
+    def __init__(self, spec: ServiceSpec,
+                 decision_interval_seconds: float,
+                 qps_window_seconds: float = QPS_WINDOW_SECONDS) -> None:
+        super().__init__(spec, decision_interval_seconds,
+                         qps_window_seconds)
+        from skypilot_tpu.serve import metrics_math
+        self._math = metrics_math
+        # Per-SERIES windows (one per replica label): reset detection
+        # must see each replica's own cumulative counts, or any replica
+        # restart/departure would clear the whole window and a rejoin
+        # would inject lifetime counts (metrics_math docstring).
+        self._ttft_window = metrics_math.FederatedWindowedHistogram(
+            qps_window_seconds)
+        self._tpot_window = metrics_math.FederatedWindowedHistogram(
+            qps_window_seconds)
+        # Last measured state, for logs/status introspection.
+        self.last_p95_ttft_ms: Optional[float] = None
+        self.last_p95_tpot_ms: Optional[float] = None
+        self.last_backlog_tokens: float = 0.0
+
+    def adopt_history(self, old: 'Autoscaler') -> None:
+        """Also carry the histogram scrape windows across a `serve
+        update` rebuild: an empty window would blind the SLO signal for
+        a full window right when a rollout is perturbing latency."""
+        super().adopt_history(old)
+        for attr in ('_ttft_window', '_tpot_window'):
+            theirs = getattr(old, attr, None)
+            if theirs is not None and hasattr(theirs, '_series'):
+                getattr(self, attr).adopt(theirs)
+
+    def observe_exposition(self, exposition: str,
+                           now: Optional[float] = None) -> None:
+        """Fold one federated scrape into the measurement windows."""
+        samples = self._math.parse_samples(exposition)
+        self._ttft_window.record(
+            self._math.histogram_cumulative_by_series(
+                samples, self.TTFT_FAMILY), now)
+        self._tpot_window.record(
+            self._math.histogram_cumulative_by_series(
+                samples, self.TPOT_FAMILY), now)
+        self.last_backlog_tokens = self._math.gauge_total(
+            samples, self.BACKLOG_FAMILY)
+
+    def _p95s(self, now: Optional[float] = None
+              ) -> Tuple[Optional[float], Optional[float]]:
+        """(p95 TTFT ms, p95 TPOT ms) over the window; None per family
+        without samples — including when the newest scrape predates the
+        window (scrape source dark: deciding on that frozen data would
+        keep scaling on a latency picture minutes old)."""
+        ttft = self._ttft_window.quantile(self.QUANTILE, now)
+        tpot = self._tpot_window.quantile(self.QUANTILE, now)
+        self.last_p95_ttft_ms = ttft * 1e3 if ttft is not None else None
+        self.last_p95_tpot_ms = tpot * 1e3 if tpot is not None else None
+        return self.last_p95_ttft_ms, self.last_p95_tpot_ms
+
+    def _slo_pairs(self, now: Optional[float] = None
+                   ) -> List[Tuple[Optional[float], float]]:
+        """(measured p95 ms, target ms) for each configured SLO."""
+        ttft, tpot = self._p95s(now)
+        pairs = []
+        if self.spec.target_ttft_ms is not None:
+            pairs.append((ttft, self.spec.target_ttft_ms))
+        if self.spec.target_tpot_ms is not None:
+            pairs.append((tpot, self.spec.target_tpot_ms))
+        return pairs
+
+    def evaluate_scrape(self, exposition: Optional[str],
+                        total_requests: int, num_live_replicas: int,
+                        now: Optional[float] = None) -> AutoscalerDecision:
+        now = time.time() if now is None else now
+        self.record_request_count(total_requests, now)
+        if exposition is not None:
+            self.observe_exposition(exposition, now)
+        else:
+            # Scrape failed: the backlog figure is as stale as the
+            # histograms — 0 means "no evidence", so neither the shed
+            # check nor a downscale projection runs on frozen data.
+            self.last_backlog_tokens = 0.0
+        qps_desired = int(math.ceil(self.current_qps_from_counter() /
+                                    self.spec.target_qps_per_replica))
+        pairs = self._slo_pairs(now)
+        measured = [(p95, target) for p95, target in pairs
+                    if p95 is not None]
+        if not measured:
+            # No latency samples in the window: pure QPS behavior.
+            return self._apply_hysteresis(qps_desired,
+                                          num_live_replicas)
+        live = max(num_live_replicas, 1)
+        violated = any(p95 > target for p95, target in measured)
+        if self.spec.max_queue_tokens_per_replica is not None and \
+                self.last_backlog_tokens > \
+                self.spec.max_queue_tokens_per_replica * live:
+            # The LB is shedding (or about to): latency of ADMITTED
+            # requests can look healthy exactly because demand is being
+            # turned away — the backlog says scale anyway.
+            violated = True
+        if violated:
+            # One more than what is RUNNING (not just our own target:
+            # after adoption or manual changes live can exceed it, and a
+            # service violating at `live` replicas needs > live).
+            desired = max(qps_desired,
+                          max(self.target_num_replicas, live) + 1)
+        elif qps_desired < self.target_num_replicas:
+            # QPS argues for fewer replicas: allow it only if the
+            # load-proportional projection of every measured p95 at the
+            # shrunken count still meets its target.
+            candidate = max(qps_desired, self.spec.min_replicas, 1)
+            projected_ok = all(
+                p95 * (live / candidate) <= target
+                for p95, target in measured)
+            desired = qps_desired if projected_ok \
+                else self.target_num_replicas
+        else:
+            desired = max(qps_desired, self.target_num_replicas)
+        return self._apply_hysteresis(desired, num_live_replicas)

@@ -62,7 +62,9 @@ class ServiceController:
             service_name, rec['lb_port'],
             LoadBalancingPolicy.make(self.spec.load_balancing_policy),
             self.manager.ready_urls,
-            ready_replicas_fn=self.manager.ready_replicas)
+            ready_replicas_fn=self.manager.ready_replicas,
+            max_queue_tokens_per_replica=(
+                self.spec.max_queue_tokens_per_replica))
         self.autoscaler = Autoscaler.make(self.spec, _tick_interval(),
                                           _qps_window())
 
@@ -127,6 +129,8 @@ class ServiceController:
                                           self.version)
                 self.lb.policy = LoadBalancingPolicy.make(
                     self.spec.load_balancing_policy)
+                self.lb.max_queue_tokens_per_replica = \
+                    self.spec.max_queue_tokens_per_replica
                 new_autoscaler = Autoscaler.make(
                     self.spec, _tick_interval(), _qps_window())
                 # Keep the QPS sample history: an empty window would
@@ -144,20 +148,62 @@ class ServiceController:
                 continue
             # QPS from the LB's monotonic request counter — the same
             # series /metrics exports, not a parallel timestamp trace.
-            decision = self.autoscaler.evaluate_counter(
-                self.lb.proxied_requests(), self.manager.num_live(), now)
+            # SLO policies additionally get the LB's FEDERATED /metrics
+            # text (engine TTFT/TPOT histograms + backlog gauges of
+            # every ready replica): one scrape, the same bytes the
+            # dashboards read.
+            exposition = (self._scrape_lb_metrics()
+                          if self.autoscaler.wants_lb_scrape else None)
+            decision = self.autoscaler.evaluate_scrape(
+                exposition, self.lb.proxied_requests(),
+                self.manager.num_live(), now)
             if decision.delta > 0:
                 logger.info(f'Service {self.service_name!r}: scaling up '
                             f'by {decision.delta} to '
-                            f'{decision.target_num_replicas}.')
+                            f'{decision.target_num_replicas}'
+                            f'{self._slo_note()}.')
                 self.manager.scale_up(decision.delta)
             elif decision.delta < 0:
                 logger.info(f'Service {self.service_name!r}: scaling '
                             f'down by {-decision.delta} to '
-                            f'{decision.target_num_replicas}.')
+                            f'{decision.target_num_replicas}'
+                            f'{self._slo_note()}.')
                 self.manager.scale_down(-decision.delta)
             self._update_service_status()
             _shutdown.wait(_tick_interval())
+
+    def _scrape_lb_metrics(self) -> Optional[str]:
+        """One federated scrape of this service's own LB; None when the
+        scrape fails (the autoscaler then falls back to QPS)."""
+        import requests as requests_lib
+        from skypilot_tpu.serve.load_balancer import (
+            _FEDERATE_TIMEOUT_SECONDS)
+        try:
+            # Strictly ABOVE the LB's per-replica federation budget: the
+            # federated /metrics answers only after its slowest replica
+            # scrape resolves, so a smaller timeout here would miss the
+            # healthy replicas' data whenever ONE replica hangs — i.e.
+            # disable SLO scaling exactly during partial failure.  Still
+            # bounded, so a hung LB cannot stall the decision loop; a
+            # failed scrape just means QPS fallback this tick.
+            resp = requests_lib.get(
+                f'{self.lb.endpoint}/metrics',
+                timeout=_FEDERATE_TIMEOUT_SECONDS + 1.0)
+            if resp.status_code == 200:
+                return resp.text
+        except requests_lib.RequestException as e:
+            logger.debug(f'Service {self.service_name!r}: LB metrics '
+                         f'scrape failed: {e}')
+        return None
+
+    def _slo_note(self) -> str:
+        ttft = getattr(self.autoscaler, 'last_p95_ttft_ms', None)
+        tpot = getattr(self.autoscaler, 'last_p95_tpot_ms', None)
+        if ttft is None and tpot is None:
+            return ''
+        fmt = lambda v: f'{v:.1f}ms' if v is not None else 'n/a'
+        return (f' (p95 TTFT {fmt(ttft)} / TPOT {fmt(tpot)} over the '
+                f'window)')
 
     def _update_service_status(self) -> None:
         rec = serve_state.get_service(self.service_name)

@@ -12,10 +12,23 @@ the LB is handled locally and FEDERATES: it scrapes each ready replica's
 /metrics and re-exports those series relabeled with replica="<id>", so
 one scrape observes the whole service (engine TTFT/TPOT histograms
 included).
+
+Queue-aware admission control: the LB keeps a per-replica view of the
+engine's queued-prefill-token backlog — updated for free from the
+X-Skytpu-Queued-Prefill-Tokens header replicas attach to every proxied
+response, refreshed by each federated /metrics scrape — and, behind the
+`max_queue_tokens_per_replica` spec knob, sheds with 429 + a
+drain-rate-derived Retry-After BEFORE the replicas saturate (the legacy
+behavior shed only at zero ready replicas, after every queue was
+already minutes deep).  Shed requests still count in the LB's demand
+counter, so the autoscaler sees the suppressed demand and keeps scaling
+up while admission control protects latency.  The same backlog view
+feeds the least_load policy's latency-aware ranking.
 """
 from __future__ import annotations
 
 import asyncio
+import math
 import threading
 import time
 from typing import Callable, List, Optional, Tuple
@@ -24,7 +37,8 @@ import aiohttp
 from aiohttp import web
 
 from skypilot_tpu import sky_logging
-from skypilot_tpu.serve.load_balancing_policies import LoadBalancingPolicy
+from skypilot_tpu.serve.load_balancing_policies import (
+    BACKLOG_STALENESS_SECONDS, LoadBalancingPolicy)
 from skypilot_tpu.server import metrics as metrics_lib
 
 logger = sky_logging.init_logger(__name__)
@@ -37,6 +51,19 @@ _FEDERATE_TIMEOUT_SECONDS = 2.0
 # Advisory client back-off when no replica is ready (matches the
 # controller tick that could bring one up).
 _RETRY_AFTER_SECONDS = 5
+# Engine backlog header replicas attach to proxied responses
+# (inference/server.py): queued prefill tokens, read here for free on
+# the response path — no extra round trip.
+BACKLOG_HEADER = metrics_lib.BACKLOG_HEADER
+# Retry-After bounds for queue-aware sheds (finite and honest: long
+# enough to matter, short enough that clients re-offer while the
+# autoscaler's scale-up is still warming).
+_SHED_RETRY_AFTER_MAX_SECONDS = 60
+# While shedding, no responses flow, so backlog headers cannot refresh
+# the admission view; the LB re-scrapes the replicas' /metrics itself,
+# at most this often, so draining queues re-open admission promptly
+# (waiting out the full staleness window would wedge-then-burst).
+_BACKLOG_REFRESH_INTERVAL_SECONDS = 1.0
 
 
 class LoadBalancer:
@@ -45,10 +72,16 @@ class LoadBalancer:
                  policy: LoadBalancingPolicy,
                  ready_urls_fn: Callable[[], List[str]],
                  ready_replicas_fn: Optional[
-                     Callable[[], List[Tuple[int, str]]]] = None) -> None:
+                     Callable[[], List[Tuple[int, str]]]] = None,
+                 max_queue_tokens_per_replica: Optional[int] = None
+                 ) -> None:
         self.service_name = service_name
         self.port = port
         self.policy = policy
+        # Queue-aware shedding knob (service_spec
+        # max_queue_tokens_per_replica; None = legacy behavior, shed
+        # only at zero ready replicas).  Public: `serve update` swaps it.
+        self.max_queue_tokens_per_replica = max_queue_tokens_per_replica
         self._ready_urls_fn = ready_urls_fn
         # Optional richer view: [(replica_id, url)].  Used to label
         # per-replica series and to federate /metrics; without it the
@@ -56,8 +89,21 @@ class LoadBalancer:
         self._ready_replicas_fn = ready_replicas_fn
         # Monotonic proxied-request count (mirrors the
         # skytpu_lb_requests_total family).  The autoscaler samples this
-        # instead of a parallel timestamp deque.
+        # instead of a parallel timestamp deque.  Shed requests COUNT:
+        # suppressed demand must stay visible to scaling.
         self._request_count = 0
+        # url -> (queued prefill tokens, monotonic observed-at).  Only
+        # touched on the LB's own event loop (response path + federated
+        # scrape), so no lock.
+        self._backlog: dict = {}
+        self._last_ready_set: frozenset = frozenset()
+        # EWMA of observed backlog drain (tokens/sec across the
+        # service), the basis of the shed Retry-After.
+        self._drain_rate_tok_s: Optional[float] = None
+        # Self-refresh bookkeeping (LB event loop only): last kick time
+        # and an in-flight guard, rate-limiting the shed-path re-scrape.
+        self._backlog_refresh_at = -1e18
+        self._backlog_refreshing = False
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
         self._started = threading.Event()
@@ -73,16 +119,152 @@ class LoadBalancer:
         return self._request_count
 
     def _ready(self) -> Tuple[List[str], dict]:
-        """One state read per request: (urls, url -> replica label)."""
+        """One state read per request: (urls, url -> replica label).
+        On a ready-set change, per-replica state for departed URLs is
+        pruned (autoscaling churn mints a fresh URL per replica; the
+        maps would otherwise grow for the LB's lifetime)."""
         if self._ready_replicas_fn is not None:
             pairs = self._ready_replicas_fn()
-            return [u for _, u in pairs], {u: str(r) for r, u in pairs}
-        return self._ready_urls_fn(), {}
+            urls, labels = ([u for _, u in pairs],
+                            {u: str(r) for r, u in pairs})
+        else:
+            urls, labels = self._ready_urls_fn(), {}
+        current = frozenset(urls)
+        if current != self._last_ready_set:
+            self._last_ready_set = current
+            for stale in [u for u in self._backlog if u not in current]:
+                del self._backlog[stale]
+            self.policy.prune(current)
+        return urls, labels
+
+    # ----- queue-aware admission ----------------------------------------------
+    def _note_backlog(self, url: str, tokens: float) -> None:
+        """Fold one replica backlog observation into the admission view
+        and the routing policy; successive decreases feed the drain-rate
+        EWMA the shed Retry-After is derived from."""
+        now = time.monotonic()
+        prev = self._backlog.get(url)
+        if prev is not None:
+            prev_tokens, prev_t = prev
+            dt = now - prev_t
+            if dt > 1e-3 and tokens < prev_tokens:
+                rate = (prev_tokens - tokens) / dt
+                self._drain_rate_tok_s = rate \
+                    if self._drain_rate_tok_s is None \
+                    else 0.3 * rate + 0.7 * self._drain_rate_tok_s
+        self._backlog[url] = (max(0.0, tokens), now)
+        self.policy.update_load(url, tokens, now)
+
+    def _shed_excess_tokens(self, urls: List[str]) -> Optional[float]:
+        """Tokens above the per-replica limit on the LEAST loaded
+        replica, when admission control says shed; None to admit.
+
+        Sheds only when EVERY ready replica has a FRESH over-limit
+        backlog observation: a replica with no (or stale) data might
+        have capacity, and shedding a servable request is the worse
+        error (fail open).
+        """
+        limit = self.max_queue_tokens_per_replica
+        if limit is None or not urls:
+            return None
+        now = time.monotonic()
+        fresh = []
+        for url in urls:
+            obs = self._backlog.get(url)
+            if obs is None or now - obs[1] > BACKLOG_STALENESS_SECONDS:
+                return None
+            fresh.append(obs[0])
+        least = min(fresh)
+        if least < limit:
+            return None
+        return least - limit
+
+    def _kick_backlog_refresh(self, urls: List[str]) -> None:
+        """Fire-and-forget re-scrape of the replicas' /metrics backlog
+        gauges, rate-limited to one in flight per
+        _BACKLOG_REFRESH_INTERVAL_SECONDS.  Called from the shed path:
+        while every request is shed, nothing else refreshes the
+        admission view, and without this the LB would hold 429s for the
+        whole staleness window after the queues drained, then fail open
+        into a burst."""
+        now = time.monotonic()
+        if self._backlog_refreshing or \
+                now - self._backlog_refresh_at < \
+                _BACKLOG_REFRESH_INTERVAL_SECONDS:
+            return
+        self._backlog_refreshing = True
+        self._backlog_refresh_at = now
+
+        async def refresh():
+            try:
+                async def one(url):
+                    try:
+                        assert self._session is not None
+                        async with self._session.get(
+                                url.rstrip('/') + '/metrics',
+                                timeout=aiohttp.ClientTimeout(
+                                    total=_FEDERATE_TIMEOUT_SECONDS)
+                        ) as resp:
+                            if resp.status == 200:
+                                self._note_backlog_from_exposition(
+                                    url, await resp.text())
+                    except (aiohttp.ClientError, asyncio.TimeoutError,
+                            OSError):
+                        pass
+                await asyncio.gather(*(one(u) for u in urls))
+            finally:
+                self._backlog_refreshing = False
+
+        asyncio.ensure_future(refresh())
+
+    def _note_backlog_from_exposition(self, url: str, text: str) -> None:
+        """Refresh one replica's backlog from its scraped /metrics — the
+        path that unblocks shedding: while the LB sheds, no responses
+        flow, so response headers alone would leave the over-limit view
+        frozen until staleness."""
+        from skypilot_tpu.serve import metrics_math
+        samples = metrics_math.parse_samples(text)
+        found = [v for name, _, v in samples
+                 if name == metrics_lib.QUEUED_PREFILL_TOKENS_FAMILY]
+        if found:
+            self._note_backlog(url, sum(found))
+
+    def _shed_retry_after(self, excess_tokens: float) -> int:
+        """Seconds until the least-loaded replica's backlog should be
+        back under the limit, from the observed drain rate; a finite
+        integer always (RFC 7231 delta-seconds)."""
+        rate = self._drain_rate_tok_s
+        if rate is None or rate <= 0:
+            return _RETRY_AFTER_SECONDS
+        return int(min(_SHED_RETRY_AFTER_MAX_SECONDS,
+                       max(1, math.ceil(excess_tokens / rate))))
 
     # ----- data plane ---------------------------------------------------------
     async def _handle(self, request: web.Request) -> web.StreamResponse:
         self._request_count += 1
         urls, labels = self._ready()
+        excess = self._shed_excess_tokens(urls)
+        if excess is not None:
+            # Queue-aware shed: every ready replica's engine backlog is
+            # at/over the limit — 429 now beats joining a queue that
+            # already violates the SLO.  Own counter (no replica label:
+            # the request never reached one), and the request already
+            # counted in _request_count above, so the autoscaler still
+            # sees the suppressed demand and keeps scaling up.
+            retry_after = self._shed_retry_after(excess)
+            # While shedding, response headers stop flowing: keep the
+            # admission view current ourselves.
+            self._kick_backlog_refresh(urls)
+            metrics_lib.inc_counter('skytpu_lb_shed_total',
+                                    service=self.service_name)
+            metrics_lib.inc_counter('skytpu_lb_requests_total',
+                                    service=self.service_name,
+                                    replica='none', code='429')
+            return web.json_response(
+                {'error': f'service {self.service_name} over queue '
+                          f'limit; retry after {retry_after}s'},
+                status=429,
+                headers={'Retry-After': str(retry_after)})
         url = self.policy.select(urls)
         if url is None:
             metrics_lib.inc_counter('skytpu_lb_no_ready_replicas_total',
@@ -114,6 +296,12 @@ class LoadBalancer:
                     data=body if body else None,
                     allow_redirects=False) as upstream:
                 code = str(upstream.status)
+                backlog_raw = upstream.headers.get(BACKLOG_HEADER)
+                if backlog_raw is not None:
+                    try:
+                        self._note_backlog(url, float(backlog_raw))
+                    except ValueError:
+                        pass
                 resp = web.StreamResponse(status=upstream.status)
                 for k, v in upstream.headers.items():
                     if k.lower() not in _HOP_HEADERS and \
@@ -152,10 +340,11 @@ class LoadBalancer:
                          f'before response: {e}')
             return web.Response(status=499)
         finally:
-            self.policy.on_request_end(url)
+            duration_s = time.perf_counter() - t0
+            self.policy.on_request_end(url, duration_s)
             metrics_lib.observe_hist(
                 'skytpu_lb_request_duration_seconds',
-                time.perf_counter() - t0,
+                duration_s,
                 service=self.service_name, replica=replica)
             metrics_lib.inc_counter(
                 'skytpu_lb_requests_total',
@@ -183,7 +372,9 @@ class LoadBalancer:
                         timeout=aiohttp.ClientTimeout(
                             total=_FEDERATE_TIMEOUT_SECONDS)) as resp:
                     if resp.status == 200:
-                        return (str(rid), await resp.text())
+                        text = await resp.text()
+                        self._note_backlog_from_exposition(url, text)
+                        return (str(rid), text)
             except (aiohttp.ClientError, asyncio.TimeoutError,
                     OSError) as e:
                 logger.debug(f'LB {self.service_name}: replica {rid} '

@@ -46,6 +46,19 @@ class ServiceSpec:
     # SKYTPU_SERVE_MAX_PROMPT_LEN (the inference server's
     # --max-prompt-len default).
     max_prompt_len: Optional[int] = None
+    # Latency SLO targets (milliseconds): with either set, the
+    # controller runs the SLOAutoscaler — scale up on p95 TTFT/TPOT
+    # violation measured from the LB's federated histograms, scale down
+    # only when the projected post-scale-down p95 still meets the SLO.
+    # QPS (target_qps_per_replica) stays the fallback signal when no
+    # histogram samples exist in the window.
+    target_ttft_ms: Optional[float] = None
+    target_tpot_ms: Optional[float] = None
+    # Queue-aware load shedding at the LB: 429 + Retry-After once every
+    # ready replica's engine backlog (queued prefill tokens) reaches
+    # this, BEFORE the replicas saturate.  None disables shedding
+    # (legacy behavior: reject only at zero ready replicas).
+    max_queue_tokens_per_replica: Optional[int] = None
 
     @classmethod
     def from_yaml_config(cls, config: Dict[str, Any]) -> 'ServiceSpec':
@@ -71,6 +84,13 @@ class ServiceSpec:
         max_prompt_raw = config.get('max_prompt_len')
         max_prompt_len = (int(max_prompt_raw)
                           if max_prompt_raw is not None else None)
+        shed_raw = config.get('max_queue_tokens_per_replica')
+        max_queue_tokens = int(shed_raw) if shed_raw is not None else None
+        if max_queue_tokens is not None and max_queue_tokens <= 0:
+            raise exceptions.InvalidTaskError(
+                'service.max_queue_tokens_per_replica must be positive '
+                f'(got {max_queue_tokens}) — a zero limit sheds every '
+                'request')
         if policy is None:
             n = int(fixed if fixed is not None else 1)
             return cls(readiness_probe=probe, min_replicas=n,
@@ -78,7 +98,8 @@ class ServiceSpec:
                        load_balancing_policy=config.get(
                            'load_balancing_policy', 'least_load'),
                        tensor_parallel=tensor_parallel,
-                       max_prompt_len=max_prompt_len)
+                       max_prompt_len=max_prompt_len,
+                       max_queue_tokens_per_replica=max_queue_tokens)
         min_r = int(policy.get('min_replicas', 1))
         max_r = policy.get('max_replicas')
         target_qps = policy.get('target_qps_per_replica')
@@ -95,6 +116,24 @@ class ServiceSpec:
             raise exceptions.InvalidTaskError(
                 f'service.replica_policy: max_replicas ({max_r}) < '
                 f'min_replicas ({min_r})')
+        target_ttft = policy.get('target_ttft_ms')
+        target_tpot = policy.get('target_tpot_ms')
+        for knob, val in (('target_ttft_ms', target_ttft),
+                          ('target_tpot_ms', target_tpot)):
+            if val is not None and float(val) <= 0:
+                raise exceptions.InvalidTaskError(
+                    f'service.replica_policy: {knob} must be a positive '
+                    f'latency in milliseconds (got {val})')
+        if (target_ttft is not None or target_tpot is not None) and \
+                target_qps is None:
+            # The SLO autoscaler falls back to QPS when the histogram
+            # window is empty (cold service, replicas not yet scraped):
+            # without a QPS target there is no fallback signal at all.
+            raise exceptions.InvalidTaskError(
+                'service.replica_policy: target_ttft_ms/target_tpot_ms '
+                'require target_qps_per_replica (and max_replicas) — '
+                'QPS is the fallback signal when no latency samples '
+                'exist yet')
         return cls(
             readiness_probe=probe,
             min_replicas=min_r,
@@ -113,6 +152,11 @@ class ServiceSpec:
                 policy.get('base_ondemand_fallback_replicas', 0)),
             tensor_parallel=tensor_parallel,
             max_prompt_len=max_prompt_len,
+            target_ttft_ms=(float(target_ttft)
+                            if target_ttft is not None else None),
+            target_tpot_ms=(float(target_tpot)
+                            if target_tpot is not None else None),
+            max_queue_tokens_per_replica=max_queue_tokens,
         )
 
     def to_yaml_config(self) -> Dict[str, Any]:
@@ -141,6 +185,10 @@ class ServiceSpec:
             if self.base_ondemand_fallback_replicas:
                 policy['base_ondemand_fallback_replicas'] = \
                     self.base_ondemand_fallback_replicas
+            if self.target_ttft_ms is not None:
+                policy['target_ttft_ms'] = self.target_ttft_ms
+            if self.target_tpot_ms is not None:
+                policy['target_tpot_ms'] = self.target_tpot_ms
             out['replica_policy'] = policy
         else:
             out['replicas'] = self.min_replicas
@@ -149,9 +197,20 @@ class ServiceSpec:
             out['tensor_parallel'] = self.tensor_parallel
         if self.max_prompt_len is not None:
             out['max_prompt_len'] = self.max_prompt_len
+        if self.max_queue_tokens_per_replica is not None:
+            out['max_queue_tokens_per_replica'] = \
+                self.max_queue_tokens_per_replica
         return out
 
     @property
     def autoscaling_enabled(self) -> bool:
         return self.max_replicas is not None and \
             self.target_qps_per_replica is not None
+
+    @property
+    def slo_autoscaling_enabled(self) -> bool:
+        """Latency-SLO autoscaling: scale on p95 TTFT/TPOT from the
+        federated histograms, with QPS as the fallback signal."""
+        return self.autoscaling_enabled and (
+            self.target_ttft_ms is not None or
+            self.target_tpot_ms is not None)

@@ -81,6 +81,9 @@ _HELP = {
         'Proxied request wall time, per replica',
     'skytpu_lb_no_ready_replicas_total':
         'Requests rejected 503 because no replica was ready',
+    'skytpu_lb_shed_total':
+        'Requests shed 429 by queue-aware admission control (every '
+        'ready replica over max_queue_tokens_per_replica)',
     # ----- training -------------------------------------------------------
     'skytpu_train_step_seconds': 'Train step wall time',
     'skytpu_train_tokens_per_second':
@@ -120,6 +123,19 @@ _BUCKETS: Dict[str, Tuple[float, ...]] = {
         (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
          60.0, 120.0),
 }
+
+# Family names referenced OUTSIDE the exporting process (the LB's
+# admission control, the SLO autoscaler, and the bench sim all read
+# this gauge out of scraped exposition text): shared constants so a
+# rename cannot silently sever a consumer (the fail-open readers would
+# just find nothing).
+QUEUED_PREFILL_TOKENS_FAMILY = 'skytpu_engine_queued_prefill_tokens'
+ENGINE_TTFT_FAMILY = 'skytpu_engine_ttft_seconds'
+ENGINE_TPOT_FAMILY = 'skytpu_engine_inter_token_seconds'
+# Response header the inference server stamps the queued-prefill-token
+# backlog on; the serve LB reads it on the proxy response path (same
+# cross-process contract as the gauge above, same drift risk).
+BACKLOG_HEADER = 'X-Skytpu-Queued-Prefill-Tokens'
 
 _started_at = time.time()
 

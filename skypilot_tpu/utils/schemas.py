@@ -83,6 +83,14 @@ _SERVICE_SCHEMA: Dict[str, Any] = {
                 'downscale_delay_seconds': {'type': 'number'},
                 'dynamic_ondemand_fallback': {'type': 'boolean'},
                 'base_ondemand_fallback_replicas': {'type': 'integer'},
+                # Latency SLO targets (milliseconds): with either set,
+                # the controller scales on p95 TTFT/TPOT from the LB's
+                # federated histograms (SLOAutoscaler) with QPS as the
+                # fallback signal.  A zero or negative SLO is nonsense.
+                'target_ttft_ms': {'type': 'number',
+                                   'exclusiveMinimum': 0},
+                'target_tpot_ms': {'type': 'number',
+                                   'exclusiveMinimum': 0},
             },
         },
         'replicas': {'type': 'integer', 'minimum': 0},
@@ -96,6 +104,13 @@ _SERVICE_SCHEMA: Dict[str, Any] = {
         # workload as SKYTPU_SERVE_MAX_PROMPT_LEN; omitted = the model
         # limit — chunked prefill serves prompts up to max_seq_len - 1).
         'max_prompt_len': {'type': 'integer', 'minimum': 1},
+        # Queue-aware load shedding at the LB: when every ready
+        # replica's engine backlog (queued prefill tokens, from the
+        # federated gauges / replica response headers) is at or above
+        # this, new requests get 429 + Retry-After instead of joining a
+        # queue that already violates the SLO.  A zero limit would shed
+        # everything — minimum 1.
+        'max_queue_tokens_per_replica': {'type': 'integer', 'minimum': 1},
     },
 }
 

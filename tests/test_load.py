@@ -78,3 +78,63 @@ def test_concurrent_load(chaos_server):  # noqa: F811
     # Server is still healthy after the storm.
     assert requests_lib.get(f'http://127.0.0.1:{port}/api/health',
                             timeout=10).json()['status'] == 'healthy'
+
+
+# ----- SLO autoscaling under a traffic ramp ----------------------------------
+# ROADMAP item-4 "done when": under a traffic ramp, the SLO autoscaler
+# holds p95 TPOT at/below target while the QPS autoscaler — with the
+# SAME replica budget and the same ideal provisioning — violates it.
+# Virtual replicas + simulated latency histograms (slo_sim), consumed by
+# the autoscaler as real federated exposition text; virtual time, no
+# sleeps.
+import pytest
+
+# Scenario constants + driver live in slo_sim (the exact config
+# bench.py's bench_slo_ramp runs, so the bench numbers the README pins
+# and this asserting test describe the SAME experiment).
+from skypilot_tpu.serve.slo_sim import (DEFAULT_TARGET_TPOT_MS as
+                                        TARGET_TPOT_MS)
+
+
+def _run(qps_schedule, slo: bool):
+    from skypilot_tpu.serve import slo_sim
+    return slo_sim.run_policy(slo, qps_schedule)
+
+
+def test_slo_autoscaler_holds_p95_where_qps_autoscaler_fails():
+    from skypilot_tpu.serve import slo_sim
+    ramp = slo_sim.default_ramp(plateau_ticks=12)
+    slo_hist = _run(ramp, slo=True)
+    qps_hist = _run(ramp, slo=False)
+    p95_slo = slo_sim.requests_weighted_p95(slo_hist, last_n_ticks=4)
+    p95_qps = slo_sim.requests_weighted_p95(qps_hist, last_n_ticks=4)
+    # The SLO policy converges to a replica count that meets the target…
+    assert p95_slo <= TARGET_TPOT_MS, (p95_slo, slo_hist)
+    # …the QPS policy, with the identical budget, violates it badly.
+    assert p95_qps > 2 * TARGET_TPOT_MS, (p95_qps, qps_hist)
+    # Both stayed inside the same budget; the SLO one actually used it.
+    assert max(r for _, r, _ in slo_hist) <= 8
+    assert max(r for _, r, _ in qps_hist) <= 8
+    assert slo_hist[-1][1] > qps_hist[-1][1]
+
+
+@pytest.mark.slow
+def test_slo_ramp_soak_repeated_cycles():
+    """Soak variant: three full ramp/plateau/trough cycles.  The SLO
+    policy must hold the target on EVERY plateau (no decay of the
+    signal across cycles — windowed deltas, counter resets, and the
+    downscale projection all keep working), and the QPS policy must
+    fail every one of them."""
+    from skypilot_tpu.serve import slo_sim
+    cycle = slo_sim.default_ramp(plateau_ticks=20) + [2.0] * 10
+    schedule = cycle * 3
+    slo_hist = _run(schedule, slo=True)
+    qps_hist = _run(schedule, slo=False)
+    n = len(cycle)
+    for c in range(3):
+        # The plateau tail of cycle c (last 4 plateau ticks).
+        lo, hi = c * n + 23, c * n + 27
+        p95_slo = slo_sim.requests_weighted_p95(slo_hist[lo:hi])
+        p95_qps = slo_sim.requests_weighted_p95(qps_hist[lo:hi])
+        assert p95_slo <= TARGET_TPOT_MS, (c, p95_slo)
+        assert p95_qps > TARGET_TPOT_MS, (c, p95_qps)

@@ -1,0 +1,237 @@
+"""metrics_math unit kit: exposition parsing and windowed
+histogram-quantile math — property-tested against references computed
+from the raw samples the histograms were built from."""
+import math
+import random
+
+import pytest
+
+from skypilot_tpu.serve import metrics_math
+from skypilot_tpu.server import metrics as metrics_lib
+
+
+# ----- exposition parsing -----------------------------------------------------
+def test_parse_samples_basic_and_labels():
+    text = (
+        '# HELP foo_total help text\n'
+        '# TYPE foo_total counter\n'
+        'foo_total{service="svc",replica="3"} 42\n'
+        'bar_gauge 1.5\n'
+        'baz_bucket{le="+Inf"} 7\n')
+    samples = metrics_math.parse_samples(text)
+    assert ('foo_total', {'service': 'svc', 'replica': '3'}, 42.0) \
+        in samples
+    assert ('bar_gauge', {}, 1.5) in samples
+    assert ('baz_bucket', {'le': '+Inf'}, 7.0) in samples
+
+
+def test_parse_samples_skips_garbage_and_nan():
+    text = ('ok_metric 1\n'
+            'this is not exposition at all\n'
+            '<html>502 bad gateway</html>\n'
+            'nan_metric NaN\n'
+            'inf_metric +Inf\n')
+    samples = metrics_math.parse_samples(text)
+    names = [n for n, _, _ in samples]
+    assert names == ['ok_metric', 'inf_metric']
+    assert samples[1][2] == math.inf
+
+
+def test_parse_samples_unescapes_label_values():
+    text = 'm{k="a\\"b\\\\c\\nd"} 1\n'
+    ((_, labels, _),) = metrics_math.parse_samples(text)
+    assert labels['k'] == 'a"b\\c\nd'
+
+
+def test_histogram_cumulative_sums_across_replicas():
+    text = (
+        'fam_bucket{le="0.1",replica="0"} 2\n'
+        'fam_bucket{le="+Inf",replica="0"} 3\n'
+        'fam_bucket{le="0.1",replica="1"} 5\n'
+        'fam_bucket{le="+Inf",replica="1"} 5\n'
+        'other_bucket{le="0.1"} 99\n'
+        'fam_sum{replica="0"} 1.0\n')
+    cum = metrics_math.histogram_cumulative(
+        metrics_math.parse_samples(text), 'fam')
+    assert cum == {0.1: 7.0, math.inf: 8.0}
+
+
+def test_gauge_and_counter_totals():
+    text = ('g{replica="0"} 10\n'
+            'g{replica="1"} 32\n'
+            'c_total{code="429",service="s"} 4\n'
+            'c_total{code="200",service="s"} 9\n')
+    samples = metrics_math.parse_samples(text)
+    assert metrics_math.gauge_total(samples, 'g') == 42.0
+    assert metrics_math.counter_total(samples, 'c_total',
+                                      code='429') == 4.0
+    assert metrics_math.counter_total(samples, 'c_total') == 13.0
+
+
+# ----- quantile ---------------------------------------------------------------
+def test_quantile_empty_and_zero_histograms():
+    assert metrics_math.quantile_from_cumulative({}, 0.95) is None
+    assert metrics_math.quantile_from_cumulative(
+        {0.1: 0.0, math.inf: 0.0}, 0.95) is None
+    with pytest.raises(ValueError):
+        metrics_math.quantile_from_cumulative({0.1: 1.0}, 1.5)
+
+
+def test_quantile_exact_boundary():
+    # Every observation exactly at a bucket bound: rank q*total lands
+    # exactly on the bucket's cumulative count — no interpolation past
+    # the bound (Prometheus returns the bound itself).
+    cum = {0.1: 10.0, 0.5: 10.0, math.inf: 10.0}
+    assert metrics_math.quantile_from_cumulative(cum, 0.95) == \
+        pytest.approx(0.095)
+    assert metrics_math.quantile_from_cumulative(cum, 1.0) == 0.1
+
+
+def test_quantile_rank_in_inf_bucket_clamps_to_largest_finite():
+    # 40% of observations beyond the largest finite bound: the p95 rank
+    # lands in +Inf — the honest answer for SLO comparison is the
+    # largest finite bound (data says "worse than everything
+    # resolvable"; every real target is finite, so >= still trips).
+    cum = {0.1: 3.0, 1.0: 6.0, math.inf: 10.0}
+    assert metrics_math.quantile_from_cumulative(cum, 0.95) == 1.0
+
+
+def test_quantile_interpolates_within_bucket():
+    # 100 obs uniform in (0.1, 0.5] bucket region: p50 should land
+    # mid-bucket by linear interpolation.
+    cum = {0.1: 0.0, 0.5: 100.0, math.inf: 100.0}
+    assert metrics_math.quantile_from_cumulative(cum, 0.5) == \
+        pytest.approx(0.3)
+
+
+def _cumulative_from_raw(values, bounds):
+    """Reference cumulative map built directly from raw samples."""
+    cum = {}
+    for b in list(bounds) + [math.inf]:
+        cum[b] = float(sum(1 for v in values if v <= b))
+    return cum
+
+
+def test_quantile_property_against_raw_samples():
+    """Property test: for random sample sets, the bucket-delta quantile
+    must bracket the TRUE raw-sample quantile — it can never leave the
+    bucket the true quantile lives in, and interpolation keeps it
+    within [previous bound, bucket bound]."""
+    bounds = metrics_lib.buckets_for('skytpu_engine_inter_token_seconds')
+    rng = random.Random(1234)
+    for trial in range(50):
+        n = rng.randrange(1, 200)
+        # Mix of scales so every bucket (incl. +Inf) gets exercised.
+        values = [rng.choice((rng.uniform(0, 0.002),
+                              rng.uniform(0, 0.1),
+                              rng.uniform(0, 2.0)))
+                  for _ in range(n)]
+        cum = _cumulative_from_raw(values, bounds)
+        for q in (0.5, 0.9, 0.95, 0.99):
+            est = metrics_math.quantile_from_cumulative(cum, q)
+            true_q = sorted(values)[max(0,
+                                        math.ceil(q * n) - 1)]
+            # The bucket the true quantile falls in:
+            upper = min((b for b in bounds if true_q <= b),
+                        default=None)
+            if upper is None:
+                # True quantile beyond the largest finite bound: the
+                # estimate clamps to that bound.
+                assert est == bounds[-1], (trial, q, true_q, est)
+            else:
+                finite = [b for b in bounds if b < upper]
+                lower = finite[-1] if finite else 0.0
+                assert lower <= est <= upper, (trial, q, true_q, est)
+
+
+# ----- windowed histogram -----------------------------------------------------
+def _snap(ttft_pairs):
+    return dict(ttft_pairs)
+
+
+def test_windowed_histogram_deltas_and_quantile():
+    w = metrics_math.WindowedHistogram(window_seconds=60.0)
+    assert w.quantile(0.95) is None           # no snapshots at all
+    w.record({0.1: 5.0, 1.0: 5.0, math.inf: 5.0}, now=0.0)
+    assert w.quantile(0.95) is None           # single snapshot: no delta
+    assert w.sample_count() == 0.0
+    # 95 fast + 5 beyond-largest-bound observations arrive in-window.
+    w.record({0.1: 100.0, 1.0: 100.0, math.inf: 105.0}, now=30.0)
+    assert w.sample_count() == 100.0
+    est = w.quantile(0.95)
+    assert est is not None and est <= 0.1     # p95 is in the fast bucket
+    # p99.9 rank lands among the 5 post-1.0 stragglers -> clamp to the
+    # largest finite bound.
+    assert w.quantile(0.999) == 1.0
+
+
+def test_windowed_histogram_prunes_to_window_edge():
+    w = metrics_math.WindowedHistogram(window_seconds=10.0)
+    w.record({math.inf: 0.0}, now=0.0)
+    w.record({math.inf: 50.0}, now=5.0)
+    w.record({math.inf: 60.0}, now=20.0)
+    # The t=0 snapshot is outside the window but t=5 is the baseline at
+    # the edge; only observations after it count.
+    assert w.sample_count() == 10.0
+
+
+def test_windowed_histogram_counter_reset_starts_fresh():
+    w = metrics_math.WindowedHistogram(window_seconds=60.0)
+    w.record({0.1: 100.0, math.inf: 100.0}, now=0.0)
+    w.record({0.1: 110.0, math.inf: 110.0}, now=10.0)
+    # Replica restart: cumulative counts go BACKWARD.  The window must
+    # re-baseline, not produce negative deltas.
+    w.record({0.1: 3.0, math.inf: 3.0}, now=20.0)
+    assert w.sample_count() == 0.0
+    assert w.quantile(0.95) is None
+    w.record({0.1: 9.0, math.inf: 9.0}, now=30.0)
+    assert w.sample_count() == 6.0
+
+
+def test_federated_window_survives_replica_departure():
+    """Per-series windows: a replica dropping out of the scrape must
+    not clear the other replicas' measurements (a summed window would
+    see its counts vanish as a global counter reset)."""
+    w = metrics_math.FederatedWindowedHistogram(window_seconds=60.0)
+    a = (('replica', 'a'),)
+    b = (('replica', 'b'),)
+    w.record({a: {0.1: 0.0, math.inf: 0.0},
+              b: {0.1: 50.0, math.inf: 50.0}}, now=0.0)
+    # Replica b leaves the ready set; a keeps observing.
+    w.record({a: {0.1: 30.0, math.inf: 30.0}}, now=10.0)
+    assert w.sample_count(now=10.0) == 30.0
+    assert w.quantile(0.95, now=10.0) is not None
+
+
+def test_federated_window_rejoin_does_not_inject_lifetime_counts():
+    """A replica rejoining after its series aged out starts as a fresh
+    BASELINE: its since-boot cumulative counts must not land in the
+    window delta as if they were this window's observations."""
+    w = metrics_math.FederatedWindowedHistogram(window_seconds=10.0)
+    a = (('replica', 'a'),)
+    b = (('replica', 'b'),)
+    w.record({a: {math.inf: 0.0}}, now=0.0)
+    w.record({a: {math.inf: 6.0}}, now=2.0)
+    # b rejoins at t=12 carrying 10_000 lifetime observations; a's own
+    # snapshots are also refreshed (no new observations).
+    w.record({a: {math.inf: 6.0}, b: {math.inf: 10_000.0}}, now=12.0)
+    # b's first snapshot is a BASELINE — none of the 10k lifetime
+    # observations land in the window.
+    assert w.sample_count(now=12.0) == 0.0
+    # b observes 3 more: only those count.
+    w.record({a: {math.inf: 6.0}, b: {math.inf: 10_003.0}}, now=14.0)
+    assert w.sample_count(now=14.0) == pytest.approx(3.0)
+
+
+def test_federated_window_per_series_reset_is_local():
+    """One replica restarting (its counts go backward) re-baselines
+    only ITS series; the other replica's window is untouched."""
+    w = metrics_math.FederatedWindowedHistogram(window_seconds=60.0)
+    a = (('replica', 'a'),)
+    b = (('replica', 'b'),)
+    w.record({a: {math.inf: 0.0}, b: {math.inf: 100.0}}, now=0.0)
+    w.record({a: {math.inf: 20.0}, b: {math.inf: 2.0}}, now=10.0)
+    # b restarted (100 -> 2): only a's 20 observations are in-window.
+    assert w.sample_count(now=10.0) == 20.0
+    w.record({a: {math.inf: 20.0}, b: {math.inf: 8.0}}, now=20.0)
+    assert w.sample_count(now=20.0) == 26.0   # a:20 + b:6 post-reset

@@ -67,6 +67,12 @@ def test_readme_perf_claims_track_latest_bench():
     if saturated and saturated.get('ttft_saturated_ms') is not None:
         claims['saturated TTFT'] = (
             f"saturated TTFT {saturated['ttft_saturated_ms']:.1f} ms")
+    # SLO-vs-QPS autoscaling ramp (bench_slo_ramp), same contract.
+    slo_ramp = detail['serve'].get('slo_ramp')
+    if slo_ramp and slo_ramp.get('p95_tpot_ms_slo') is not None:
+        claims['SLO ramp'] = (
+            f"{slo_ramp['p95_tpot_ms_slo']:.1f} ms (SLO-aware) vs "
+            f"{slo_ramp['p95_tpot_ms_qps']:.1f} ms (QPS-only)")
     missing = {name: text for name, text in claims.items()
                if text not in readme}
     assert not missing, (
@@ -92,3 +98,25 @@ def test_readme_makes_no_unmeasured_saturated_ttft_claim():
         assert all(v == want for v in found), (
             f'README saturated-TTFT claim {found} drifted from '
             f'{path}: expected {want}')
+
+
+def test_readme_makes_no_unmeasured_slo_ramp_claim():
+    """A numeric SLO-vs-QPS ramp claim in the README must come from the
+    latest bench artifact, not be invented ahead of it."""
+    path, parsed = _latest_bench()
+    slo_ramp = (parsed['detail'].get('serve') or {}).get('slo_ramp')
+    with open(os.path.join(_ROOT, 'README.md'), encoding='utf-8') as f:
+        readme = ' '.join(f.read().split())
+    found = re.findall(
+        r'([0-9.]+) ms \(SLO-aware\) vs ([0-9.]+) ms \(QPS-only\)',
+        readme)
+    if not slo_ramp or slo_ramp.get('p95_tpot_ms_slo') is None:
+        assert not found, (
+            f'README claims an SLO-ramp result ({found}) but the '
+            f'latest bench artifact {path} has no slo_ramp scenario')
+    else:
+        want = (f"{slo_ramp['p95_tpot_ms_slo']:.1f}",
+                f"{slo_ramp['p95_tpot_ms_qps']:.1f}")
+        assert all(f == want for f in found), (
+            f'README SLO-ramp claim {found} drifted from {path}: '
+            f'expected {want}')

@@ -294,3 +294,308 @@ def test_autoscaler_adopt_history_across_serve_update():
         {'readiness_probe': '/', 'replicas': 2}), 1.0)
     fixed.adopt_history(a)
     assert fixed.evaluate_counter(999, 2, now).target_num_replicas == 2
+
+
+# ----- counter-reset clamp ----------------------------------------------------
+def test_counter_reset_treated_as_fresh_baseline():
+    """An LB restart zeroes skytpu_lb_requests_total: the sampled
+    counter goes BACKWARD.  The old behavior produced a negative delta
+    (negative QPS); the clamp must re-baseline instead, then resume
+    normal rate estimation from the new counter generation."""
+    a = RequestRateAutoscaler(_spec(), decision_interval_seconds=1.0,
+                              qps_window_seconds=10.0)
+    now = 1000.0
+    for i in range(6):
+        a.evaluate_counter(100 + 6 * i, 1, now + i)
+    assert a.current_qps_from_counter() > 0
+    d = a.evaluate_counter(3, 1, now + 6)        # restart: 130 -> 3
+    assert a.current_qps_from_counter() == 0.0   # fresh baseline, not <0
+    assert d.delta >= 0
+    # The new generation's growth drives decisions again.
+    for i in range(7, 18):
+        d = a.evaluate_counter(3 + 6 * (i - 6), 1, now + i)
+    assert a.current_qps_from_counter() == pytest.approx(6.0, rel=0.2)
+    assert d.target_num_replicas == 3
+
+
+# ----- least_load policy ------------------------------------------------------
+def test_least_load_blind_degrades_to_round_robin():
+    """No gauges, nothing outstanding: the deterministic tie-break is a
+    rotation, so a blind least_load IS round_robin (not first-URL
+    hammering)."""
+    p = LeastLoadPolicy()
+    urls = ['a', 'b', 'c']
+    assert [p.select(urls) for _ in range(6)] == ['a', 'b', 'c'] * 2
+
+
+def test_least_load_steers_away_from_backlogged_replica():
+    p = LeastLoadPolicy()
+    urls = ['a', 'b']
+    p.update_load('a', 500.0)                     # fresh, heavy backlog
+    p.update_load('b', 0.0)
+    assert all(p.select(urls) == 'b' for _ in range(4))
+    # 'a' drains: traffic returns (rotation resumes over the tie).
+    p.update_load('a', 0.0)
+    assert {p.select(urls) for _ in range(4)} == {'a', 'b'}
+
+
+def test_least_load_stale_gauges_fall_back_to_round_robin():
+    import time as time_lib
+    p = LeastLoadPolicy()
+    urls = ['a', 'b']
+    stale = time_lib.monotonic() - 2 * LeastLoadPolicy.STALENESS_SECONDS
+    p.update_load('a', 0.0, now=stale)
+    p.update_load('b', 1e6, now=stale)
+    # A stale observation says nothing about the replica NOW: both rank
+    # 0 and the rotation spreads exactly like round_robin.
+    assert [p.select(urls) for _ in range(4)] == ['a', 'b', 'a', 'b']
+
+
+def test_least_load_never_selects_not_ready_replica():
+    p = LeastLoadPolicy()
+    p.update_load('gone', 0.0)                    # idle but NOT ready
+    p.on_request_start('a')
+    p.on_request_start('a')
+    p.update_load('b', 3.0)
+    # 'gone' dropped out of the ready set: state remembered for it must
+    # not get it selected.
+    assert all(p.select(['a', 'b']) in ('a', 'b') for _ in range(6))
+
+
+def test_least_load_latency_ewma_breaks_ties():
+    p = LeastLoadPolicy()
+    urls = ['slow', 'fast']
+    p.on_request_end('slow', duration_s=5.0)      # EWMA seeds
+    p.on_request_end('fast', duration_s=0.01)
+    # Equal backlog/outstanding: the EWMA latency decides.
+    assert all(p.select(urls) == 'fast' for _ in range(4))
+
+
+# ----- SLO autoscaler ---------------------------------------------------------
+def _tpot_expo(cum, backlog=0.0):
+    """Exposition text with one inter-token histogram + backlog gauge."""
+    lines = []
+    for le, v in sorted(cum.items()):
+        le_s = '+Inf' if le == float('inf') else repr(float(le))
+        lines.append('skytpu_engine_inter_token_seconds_bucket'
+                     f'{{le="{le_s}"}} {v}')
+    lines.append(f'skytpu_engine_queued_prefill_tokens {backlog}')
+    return '\n'.join(lines) + '\n'
+
+
+def test_slo_autoscaler_selected_by_spec():
+    from skypilot_tpu.serve.autoscalers import SLOAutoscaler
+    spec = _spec(target_tpot_ms=20.0)
+    assert spec.slo_autoscaling_enabled
+    a = Autoscaler.make(spec, decision_interval_seconds=1.0)
+    assert isinstance(a, SLOAutoscaler)
+    # Without SLO targets: plain QPS autoscaler.
+    assert not isinstance(
+        Autoscaler.make(_spec(), decision_interval_seconds=1.0),
+        SLOAutoscaler)
+
+
+def test_slo_autoscaler_scales_up_on_p95_violation():
+    from skypilot_tpu.serve.autoscalers import SLOAutoscaler
+    a = SLOAutoscaler(_spec(target_tpot_ms=20.0,
+                            upscale_delay_seconds=1.0),
+                      decision_interval_seconds=1.0,
+                      qps_window_seconds=10.0)
+    inf = float('inf')
+    now = 1000.0
+    # Tick 1: first scrape is the baseline (no delta yet) — QPS path.
+    d = a.evaluate_scrape(_tpot_expo({0.01: 0.0, 0.05: 0.0, inf: 0.0}),
+                          0, 1, now)
+    assert d.target_num_replicas == 1
+    # Tick 2: 100 observations land around 40 ms (le=0.05 bucket):
+    # p95 ~ 40 ms > 20 ms target -> scale up despite tiny QPS.
+    d = a.evaluate_scrape(
+        _tpot_expo({0.01: 0.0, 0.05: 100.0, inf: 100.0}), 10, 1,
+        now + 1)
+    assert a.last_p95_tpot_ms is not None
+    assert a.last_p95_tpot_ms > 20.0
+    assert d.target_num_replicas == 2 and d.delta == 1
+
+
+def test_slo_autoscaler_falls_back_to_qps_without_samples():
+    from skypilot_tpu.serve.autoscalers import SLOAutoscaler
+    a = SLOAutoscaler(_spec(target_tpot_ms=20.0,
+                            upscale_delay_seconds=2.0),
+                      decision_interval_seconds=1.0,
+                      qps_window_seconds=10.0)
+    now = 1000.0
+    # No exposition at all (scrape failed): pure counter-QPS behavior,
+    # identical to RequestRateAutoscaler (6 qps / 2 per replica = 3
+    # desired, committed after the 2-tick hysteresis).
+    d = None
+    for i in range(13):
+        d = a.evaluate_scrape(None, 6 * i, 1, now + i)
+    assert d.target_num_replicas == 3
+    assert a.last_p95_tpot_ms is None
+
+
+def test_slo_autoscaler_blocks_downscale_that_would_violate():
+    from skypilot_tpu.serve.autoscalers import SLOAutoscaler
+    inf = float('inf')
+    now = 1000.0
+
+    def drive(target_ms):
+        # downscale_delay 2 ticks: tick 0 has no histogram delta yet
+        # (QPS fallback), so a 1-tick delay would commit a downscale
+        # before the SLO projection ever ran.
+        a = SLOAutoscaler(_spec(target_tpot_ms=target_ms,
+                                upscale_delay_seconds=1.0,
+                                downscale_delay_seconds=2.0),
+                          decision_interval_seconds=1.0,
+                          qps_window_seconds=10.0)
+        a.target_num_replicas = 4
+        # Healthy p95 (~9.5 ms, le=0.01 bucket) but idle QPS: the
+        # counter plateaus, so qps_desired collapses to min.
+        n = 0.0
+        d = None
+        for i in range(4):
+            n += 50.0
+            d = a.evaluate_scrape(
+                _tpot_expo({0.01: n, 0.05: n, inf: n}), 100, 4, now + i)
+        return d
+
+    # Projection 9.5ms * 4/1 = 38 ms > 20 ms target: downscale BLOCKED.
+    assert drive(20.0).target_num_replicas == 4
+    # Loose 50 ms target: the same projection fits -> downscale allowed.
+    assert drive(50.0).target_num_replicas == 1
+
+
+def test_slo_autoscaler_backlog_over_limit_forces_upscale():
+    """Admitted-request latency can look healthy exactly BECAUSE the LB
+    is shedding; the backlog gauge must argue for scale-up anyway."""
+    from skypilot_tpu.serve.autoscalers import SLOAutoscaler
+    spec = ServiceSpec.from_yaml_config({
+        'readiness_probe': '/health',
+        'max_queue_tokens_per_replica': 200,
+        'replica_policy': {
+            'min_replicas': 1, 'max_replicas': 5,
+            'target_qps_per_replica': 2.0,
+            'upscale_delay_seconds': 1.0,
+            'target_tpot_ms': 20.0,
+        },
+    })
+    a = SLOAutoscaler(spec, decision_interval_seconds=1.0,
+                      qps_window_seconds=10.0)
+    inf = float('inf')
+    now = 1000.0
+    a.evaluate_scrape(_tpot_expo({0.01: 0.0, inf: 0.0}), 0, 2, now)
+    # p95 ~ 9.5 ms (healthy) but 500 queued tokens > 200 x 2 replicas.
+    d = a.evaluate_scrape(
+        _tpot_expo({0.01: 100.0, inf: 100.0}, backlog=500.0),
+        10, 2, now + 1)
+    assert d.target_num_replicas == 3
+
+
+def test_slo_spec_roundtrip_and_validation():
+    spec = ServiceSpec.from_yaml_config({
+        'readiness_probe': '/health',
+        'max_queue_tokens_per_replica': 4096,
+        'replica_policy': {
+            'min_replicas': 1, 'max_replicas': 4,
+            'target_qps_per_replica': 8.0,
+            'target_ttft_ms': 500.0,
+            'target_tpot_ms': 25.0,
+        },
+    })
+    assert spec.slo_autoscaling_enabled
+    assert spec.max_queue_tokens_per_replica == 4096
+    again = ServiceSpec.from_yaml_config(spec.to_yaml_config())
+    assert again == spec
+
+    from skypilot_tpu import exceptions
+    # Negative / zero SLOs are nonsense (schema-level).
+    for knob in ('target_ttft_ms', 'target_tpot_ms'):
+        with pytest.raises(exceptions.InvalidTaskError):
+            ServiceSpec.from_yaml_config({
+                'readiness_probe': '/',
+                'replica_policy': {
+                    'min_replicas': 1, 'max_replicas': 2,
+                    'target_qps_per_replica': 1.0, knob: -5.0},
+            })
+    # Zero backlog limit would shed every request (schema minimum 1).
+    with pytest.raises(exceptions.InvalidTaskError):
+        ServiceSpec.from_yaml_config({
+            'readiness_probe': '/',
+            'replicas': 1,
+            'max_queue_tokens_per_replica': 0,
+        })
+    # SLO targets without a QPS fallback signal are rejected.
+    with pytest.raises(exceptions.InvalidTaskError):
+        ServiceSpec.from_yaml_config({
+            'readiness_probe': '/',
+            'replica_policy': {'min_replicas': 1,
+                               'target_tpot_ms': 25.0},
+        })
+
+
+def test_slo_autoscaler_adopts_windows_across_update():
+    """`serve update` must not blind the SLO signal for a full window:
+    the replacement adopts the scrape snapshots."""
+    from skypilot_tpu.serve.autoscalers import SLOAutoscaler
+    inf = float('inf')
+    now = 1000.0
+    a = SLOAutoscaler(_spec(target_tpot_ms=20.0,
+                            upscale_delay_seconds=1.0),
+                      decision_interval_seconds=1.0,
+                      qps_window_seconds=10.0)
+    a.evaluate_scrape(_tpot_expo({0.05: 0.0, inf: 0.0}), 0, 1, now)
+    a.evaluate_scrape(_tpot_expo({0.05: 50.0, inf: 50.0}), 5, 1, now + 1)
+    new = SLOAutoscaler(_spec(target_tpot_ms=20.0,
+                              upscale_delay_seconds=1.0),
+                        decision_interval_seconds=1.0,
+                        qps_window_seconds=10.0)
+    new.adopt_history(a)
+    # First post-update tick already has a window: p95 ~40 ms violates.
+    d = new.evaluate_scrape(_tpot_expo({0.05: 60.0, inf: 60.0}),
+                            6, 2, now + 2)
+    assert new.last_p95_tpot_ms is not None
+    assert d.target_num_replicas == 3
+
+
+def test_slo_autoscaler_stale_scrape_reverts_to_qps_fallback():
+    """When the LB scrape goes dark (None every tick), the measurement
+    window must EXPIRE: once the newest snapshot is older than the
+    window, p95 reads None and the policy is pure QPS — no scaling on
+    a frozen latency picture, and no downscale projection from a
+    frozen backlog figure."""
+    from skypilot_tpu.serve.autoscalers import SLOAutoscaler
+    inf = float('inf')
+    now = 1000.0
+    a = SLOAutoscaler(_spec(target_tpot_ms=20.0,
+                            upscale_delay_seconds=1.0),
+                      decision_interval_seconds=1.0,
+                      qps_window_seconds=10.0)
+    a.evaluate_scrape(_tpot_expo({0.05: 0.0, inf: 0.0}, backlog=0.0),
+                      0, 1, now)
+    d = a.evaluate_scrape(
+        _tpot_expo({0.05: 50.0, inf: 50.0}, backlog=900.0), 5, 1,
+        now + 1)
+    assert d.target_num_replicas == 2          # violating: scaled up
+    assert a.last_backlog_tokens == 900.0
+    # Scrapes fail from here on; jump past the window edge.
+    d = a.evaluate_scrape(None, 5, 2, now + 20)
+    assert a.last_p95_tpot_ms is None          # frozen data expired
+    assert a.last_backlog_tokens == 0.0        # no backlog evidence
+    assert d.target_num_replicas == 2          # QPS fallback holds
+
+
+def test_least_load_prune_drops_departed_replica_state():
+    p = LeastLoadPolicy()
+    p.update_load('keep', 5.0)
+    p.update_load('gone', 9.0)
+    p.on_request_start('gone')
+    p.on_request_end('dead', duration_s=1.0)
+    p.prune({'keep'})
+    assert list(p._backlog) == ['keep']
+    assert not p._ewma_latency
+    # In-flight counts SURVIVE a prune: they only exist while requests
+    # are in flight (self-balancing), and a readiness blip must not
+    # make a still-busy replica rank as idle when it returns.
+    assert p._outstanding == {'gone': 1}
+    p.on_request_end('gone', duration_s=0.5)
+    assert not p._outstanding

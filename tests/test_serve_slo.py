@@ -1,0 +1,232 @@
+"""Queue-aware load-shedding contract + backlog plumbing, e2e against a
+real LoadBalancer and fake replica HTTP servers.
+
+The shed contract (ISSUE 9): an over-backlog request gets 429 with a
+finite integer Retry-After BEFORE replicas saturate, the shed lands in
+its own replica-independent LB counter, suppressed demand stays visible
+to the autoscaler, and a subsequent under-backlog request is admitted
+again once the federated scrape refreshes the LB's backlog view."""
+import urllib.error
+
+import pytest
+from aiohttp import web
+
+from skypilot_tpu.server import metrics
+from test_observability import _free_port, _get, _run_app_on_thread
+
+BACKLOG_HEADER = 'X-Skytpu-Queued-Prefill-Tokens'
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    metrics.reset_for_tests()
+    yield
+    metrics.reset_for_tests()
+
+
+def _fake_replica(state, name='r'):
+    """Replica double: /work answers 200 + the engine-backlog header;
+    /metrics exports the queued-prefill-tokens gauge — both reading the
+    mutable ``state['backlog']``."""
+    app = web.Application()
+
+    async def work(_request):
+        return web.Response(
+            text=name, headers={BACKLOG_HEADER: str(state['backlog'])})
+
+    async def metrics_route(_request):
+        return web.Response(
+            text=('# TYPE skytpu_engine_queued_prefill_tokens gauge\n'
+                  f'skytpu_engine_queued_prefill_tokens '
+                  f'{state["backlog"]}\n'),
+            content_type='text/plain')
+
+    app.router.add_get('/work', work)
+    app.router.add_get('/metrics', metrics_route)
+    return app
+
+
+def test_shed_contract_429_retry_after_counter_and_readmission():
+    from skypilot_tpu.serve.load_balancer import LoadBalancer
+    from skypilot_tpu.serve.load_balancing_policies import RoundRobinPolicy
+    state = {'backlog': 250.0}
+    port, stop_replica = _run_app_on_thread(_fake_replica(state))
+    url = f'http://127.0.0.1:{port}'
+    lb = LoadBalancer('shed-svc', _free_port(), RoundRobinPolicy(),
+                      ready_urls_fn=lambda: [url],
+                      ready_replicas_fn=lambda: [(1, url)],
+                      max_queue_tokens_per_replica=100)
+    lb.start()
+    try:
+        # No backlog observation yet: admission fails OPEN (shedding a
+        # servable request is the worse error).  The response header
+        # teaches the LB the replica is over-limit.
+        status, _, _ = _get(lb.endpoint + '/work')
+        assert status == 200
+        # Over-limit and fresh: shed with 429 + finite int Retry-After.
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(lb.endpoint + '/work')
+        assert err.value.code == 429
+        retry_after = err.value.headers['Retry-After']
+        assert retry_after is not None
+        assert int(retry_after) >= 1          # finite RFC 7231 seconds
+        # The shed has its own counter (no replica label: the request
+        # never reached one) AND still counts in the demand the
+        # autoscaler reads.
+        out = metrics.render()
+        assert 'skytpu_lb_shed_total{service="shed-svc"} 1.0' in out
+        assert ('skytpu_lb_requests_total{code="429",replica="none",'
+                'service="shed-svc"} 1.0') in out
+        assert lb.proxied_requests() == 2     # suppressed demand visible
+        # A federated scrape exposes the shed counter too.
+        status, _, text = _get(lb.endpoint + '/metrics')
+        assert status == 200
+        assert 'skytpu_lb_shed_total{service="shed-svc"} 1.0' in text
+        # Backlog drains.  While shedding, no responses flow, so the
+        # federated /metrics scrape is what refreshes the LB's view
+        # (that scrape just happened above) — the next request must be
+        # ADMITTED again.
+        state['backlog'] = 10.0
+        _get(lb.endpoint + '/metrics')
+        status, _, _ = _get(lb.endpoint + '/work')
+        assert status == 200
+        # Still exactly ONE shed: the re-admitted request added none.
+        assert ('skytpu_lb_shed_total{service="shed-svc"} 1.0'
+                in metrics.render())
+    finally:
+        lb.stop()
+        stop_replica()
+
+
+def test_backlog_header_steers_least_load_routing():
+    """A replica grinding through a long chunked prefill (heavy queued-
+    prefill backlog) stops receiving requests it would delay."""
+    from skypilot_tpu.serve.load_balancer import LoadBalancer
+    from skypilot_tpu.serve.load_balancing_policies import LeastLoadPolicy
+    busy = {'backlog': 1000.0}
+    idle = {'backlog': 0.0}
+    port_a, stop_a = _run_app_on_thread(_fake_replica(busy, name='busy'))
+    port_b, stop_b = _run_app_on_thread(_fake_replica(idle, name='idle'))
+    urls = [f'http://127.0.0.1:{port_a}', f'http://127.0.0.1:{port_b}']
+    lb = LoadBalancer('route-svc', _free_port(), LeastLoadPolicy(),
+                      ready_urls_fn=lambda: list(urls),
+                      ready_replicas_fn=lambda: [(1, urls[0]),
+                                                 (2, urls[1])])
+    lb.start()
+    try:
+        # Warm-up: one request can land anywhere (blind rotation); the
+        # response headers teach the LB both backlogs.
+        _get(lb.endpoint + '/metrics')        # federated scrape: learn both
+        bodies = [_get(lb.endpoint + '/work')[2] for _ in range(6)]
+        assert all(b == 'idle' for b in bodies), bodies
+        # The busy replica drains below the idle one: traffic returns.
+        busy['backlog'] = 0.0
+        idle['backlog'] = 50.0
+        _get(lb.endpoint + '/metrics')
+        bodies = [_get(lb.endpoint + '/work')[2] for _ in range(4)]
+        assert all(b == 'busy' for b in bodies), bodies
+    finally:
+        lb.stop()
+        stop_a()
+        stop_b()
+
+
+def test_shedding_bounds_admitted_backlog_under_saturation():
+    """Saturation scenario: demand arrives faster than the replica
+    drains (here: never drains — worst case).  The legacy LB (no limit)
+    admits everything, so the queue each admitted request joins grows
+    without bound; queue-aware shedding caps it at the knob."""
+    from skypilot_tpu.serve.load_balancer import LoadBalancer
+    from skypilot_tpu.serve.load_balancing_policies import RoundRobinPolicy
+
+    def run(limit):
+        state = {'backlog': 0.0}
+        app = web.Application()
+
+        async def work(_request):
+            # Queue position the request joined at == the TTFT it will
+            # suffer (deterministic saturation model); each admission
+            # deepens the queue.
+            joined_at = state['backlog']
+            state['backlog'] += 50.0
+            return web.Response(
+                text=str(joined_at),
+                headers={BACKLOG_HEADER: str(state['backlog'])})
+
+        app.router.add_get('/work', work)
+        port, stop_replica = _run_app_on_thread(app)
+        url = f'http://127.0.0.1:{port}'
+        lb = LoadBalancer(f'sat-svc-{limit}', _free_port(),
+                          RoundRobinPolicy(),
+                          ready_urls_fn=lambda: [url],
+                          ready_replicas_fn=lambda: [(1, url)],
+                          max_queue_tokens_per_replica=limit)
+        lb.start()
+        admitted, shed = [], 0
+        try:
+            for _ in range(20):
+                try:
+                    _, _, body = _get(lb.endpoint + '/work')
+                    admitted.append(float(body))
+                except urllib.error.HTTPError as e:
+                    assert e.code == 429
+                    shed += 1
+        finally:
+            lb.stop()
+            stop_replica()
+        return admitted, shed
+
+    unlimited, shed_unlimited = run(None)
+    bounded, shed_bounded = run(200)
+    assert shed_unlimited == 0
+    assert max(unlimited) == 950.0            # queue grew with demand
+    # With the limit, every ADMITTED request joined a bounded queue.
+    assert shed_bounded > 0
+    assert max(bounded) < 200.0
+    assert len(bounded) + shed_bounded == 20
+
+
+def test_shed_path_self_refreshes_backlog_and_readmits():
+    """While every request is shed, no response headers flow — the LB
+    must re-scrape the replicas' backlog gauges ITSELF (rate-limited)
+    so a drained queue re-opens admission promptly, without an external
+    scraper and without waiting out the staleness window."""
+    import time
+    from skypilot_tpu.serve.load_balancer import LoadBalancer
+    from skypilot_tpu.serve.load_balancing_policies import RoundRobinPolicy
+    state = {'backlog': 250.0}
+    port, stop_replica = _run_app_on_thread(_fake_replica(state))
+    url = f'http://127.0.0.1:{port}'
+    lb = LoadBalancer('selfref-svc', _free_port(), RoundRobinPolicy(),
+                      ready_urls_fn=lambda: [url],
+                      ready_replicas_fn=lambda: [(1, url)],
+                      max_queue_tokens_per_replica=100)
+    lb.start()
+    try:
+        status, _, _ = _get(lb.endpoint + '/work')   # teach: over-limit
+        assert status == 200
+        # The replica drains BEFORE the next request; the LB's frozen
+        # view still says 250 so the request sheds — and that shed
+        # kicks the self-refresh.
+        state['backlog'] = 10.0
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(lb.endpoint + '/work')
+        assert err.value.code == 429
+        # Within a couple of refresh intervals admission re-opens —
+        # nobody ever scraped the LB's /metrics.
+        deadline = time.time() + 5.0
+        while True:
+            try:
+                status, _, _ = _get(lb.endpoint + '/work')
+                assert status == 200
+                break
+            except urllib.error.HTTPError as e:
+                assert e.code == 429
+                if time.time() > deadline:
+                    raise AssertionError(
+                        'LB never re-admitted after the replica '
+                        'drained (self-refresh did not land)')
+                time.sleep(0.1)
+    finally:
+        lb.stop()
+        stop_replica()
