@@ -1,0 +1,15 @@
+"""Hot-path invariant analyzer (`skytpu check`).
+
+AST-based static analysis enforcing the performance and architecture
+invariants the benchmarks rest on: one sync per decode step, zero
+mid-traffic recompiles, never-blocked event loops, one DB access
+layer, bounded outbound IO, metric-registry discipline.  See core.py
+for the framework, rules/ for the rule set, and
+tests/test_static_analysis.py for the tier-1 zero-findings gate.
+"""
+from skypilot_tpu.analysis.core import (Finding, Project, Report, Rule,
+                                        load_project, run_check)
+from skypilot_tpu.analysis.reporters import render_json, render_text
+
+__all__ = ['Finding', 'Project', 'Report', 'Rule', 'load_project',
+           'run_check', 'render_json', 'render_text']

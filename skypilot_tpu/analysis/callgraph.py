@@ -1,0 +1,263 @@
+"""Intra-package call graph: which functions are reachable from which.
+
+Rules like hot-loop-sync need "is this `np.asarray` reachable from the
+decode loop?", not "is it in engine.py?" — a sync two helper calls away
+from the loop costs the same pipelining as one inside it.  The graph is
+a deliberately conservative approximation built from names alone (no
+type inference, nothing imported):
+
+- module-level functions and class methods are indexed by qualified
+  name (``pkg.mod.Class.method``); nested defs get the CPython-style
+  ``outer.<locals>.inner`` qualname;
+- ``f(...)`` resolves to a same-module def or an imported intra-package
+  function; ``mod.f(...)`` through import aliases; ``self.m(...)`` to
+  the enclosing class (falling back to same-named methods on sibling
+  classes in the module); ``obj.m(...)`` to same-module methods named
+  ``m`` (same-file over-approximation, never cross-module guessing);
+- calling a class adds an edge to its ``__init__``.
+
+Functions that are jit-wrapped — ``@jax.jit``/``@partial(jax.jit,...)``
+decorated, or referenced in a ``jax.jit(fn)`` call — are marked
+``jit_wrapped``: their bodies trace once into a compiled program, so
+host-sync rules treat them as a different regime (a `np.asarray` there
+is a trace-time constant, not a per-step sync).
+
+Hot entry points are declared by a ``# skytpu: hot-entry`` marker on
+the def line (self-documenting at the definition), with the known
+engine/trainer/RL loops as hardcoded backstops in the sync rule.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set
+
+from skypilot_tpu.analysis.core import Module
+
+_JIT_NAMES = ('jit',)
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return '.'.join(reversed(parts))
+    return None
+
+
+def is_jit_call(node: ast.Call, module: Module) -> bool:
+    """True for jax.jit(...) / pjit(...) / functools.partial(jax.jit,..)
+    style calls (resolved through import aliases)."""
+    target = _dotted(node.func)
+    if target is None:
+        return False
+    resolved = resolve_alias(target, module)
+    if resolved.split('.')[-1] in _JIT_NAMES and \
+            resolved.split('.')[0] in ('jax', 'jit'):
+        return True
+    # functools.partial(jax.jit, ...) — the jit lives in the args.
+    if resolved.split('.')[-1] == 'partial' and node.args:
+        inner = _dotted(node.args[0])
+        if inner is not None:
+            r = resolve_alias(inner, module)
+            return r.split('.')[-1] in _JIT_NAMES and \
+                r.split('.')[0] == 'jax'
+    return False
+
+
+def resolve_alias(dotted: str, module: Module) -> str:
+    """Expand the leading segment through the module's import aliases:
+    'np.asarray' -> 'numpy.asarray', 'metrics_lib.inc_counter' ->
+    'skypilot_tpu.server.metrics.inc_counter'."""
+    head, _, rest = dotted.partition('.')
+    base = module.import_aliases.get(head)
+    if base is None:
+        return dotted
+    return f'{base}.{rest}' if rest else base
+
+
+class FunctionInfo:
+    __slots__ = ('qualname', 'module', 'node', 'is_async', 'class_name',
+                 'jit_wrapped', 'calls')
+
+    def __init__(self, qualname: str, module: Module, node,
+                 class_name: Optional[str]) -> None:
+        self.qualname = qualname
+        self.module = module
+        self.node = node
+        self.is_async = isinstance(node, ast.AsyncFunctionDef)
+        self.class_name = class_name
+        self.jit_wrapped = False
+        self.calls: List[ast.Call] = []
+
+
+class _Indexer(ast.NodeVisitor):
+    def __init__(self, module: Module, graph: 'CallGraph') -> None:
+        self.module = module
+        self.graph = graph
+        self.class_stack: List[str] = []
+        self.fn_stack: List[FunctionInfo] = []
+
+    def _qual_prefix(self) -> str:
+        if self.fn_stack:
+            return f'{self.fn_stack[-1].qualname}.<locals>'
+        if self.class_stack:
+            return (f'{self.module.modname}.'
+                    f'{".".join(self.class_stack)}')
+        return self.module.modname
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.class_stack.append(node.name)
+        self.generic_visit(node)
+        self.class_stack.pop()
+
+    def _visit_fn(self, node) -> None:
+        qual = f'{self._qual_prefix()}.{node.name}'
+        info = FunctionInfo(
+            qual, self.module, node,
+            self.class_stack[-1] if (self.class_stack and
+                                     not self.fn_stack) else None)
+        for dec in node.decorator_list:
+            if isinstance(dec, ast.Call) and \
+                    is_jit_call(dec, self.module):
+                info.jit_wrapped = True
+            else:
+                target = _dotted(dec)
+                if target is not None and resolve_alias(
+                        target, self.module).split('.')[-1] in _JIT_NAMES:
+                    info.jit_wrapped = True
+        self.graph.add_function(info)
+        self.fn_stack.append(info)
+        self.generic_visit(node)
+        self.fn_stack.pop()
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.fn_stack:
+            self.fn_stack[-1].calls.append(node)
+        # jax.jit(fn): mark a by-name-referenced local def jit-wrapped.
+        if is_jit_call(node, self.module):
+            for arg in node.args[:1]:
+                name = _dotted(arg)
+                if name is not None:
+                    self.graph.mark_jit(self.module, name.split('.')[-1])
+        self.generic_visit(node)
+
+
+class CallGraph:
+    def __init__(self, modules: List[Module]) -> None:
+        self.functions: Dict[str, FunctionInfo] = {}
+        # module -> {bare fn name -> [qualnames]} for local resolution.
+        self._by_module: Dict[str, Dict[str, List[str]]] = {}
+        self._pending_jit: List = []
+        self._modules = {m.modname: m for m in modules}
+        for m in modules:
+            _Indexer(m, self).visit(m.tree)
+        self._edges: Dict[str, Set[str]] = {}
+        for info in self.functions.values():
+            self._edges[info.qualname] = self._resolve_calls(info)
+
+    # ----- construction ------------------------------------------------------
+    def add_function(self, info: FunctionInfo) -> None:
+        self.functions[info.qualname] = info
+        names = self._by_module.setdefault(info.module.modname, {})
+        names.setdefault(info.node.name, []).append(info.qualname)
+
+    def mark_jit(self, module: Module, bare_name: str) -> None:
+        # Defs can be indexed after the jit call is seen (same pass):
+        # apply lazily against the final index.
+        self._pending_jit.append((module.modname, bare_name))
+
+    def _apply_pending_jit(self) -> None:
+        for modname, bare in self._pending_jit:
+            for qual in self._by_module.get(modname, {}).get(bare, []):
+                self.functions[qual].jit_wrapped = True
+        self._pending_jit = []
+
+    def _resolve_calls(self, info: FunctionInfo) -> Set[str]:
+        self._apply_pending_jit()
+        module = info.module
+        targets: Set[str] = set()
+        local = self._by_module.get(module.modname, {})
+        for call in info.calls:
+            func = call.func
+            if isinstance(func, ast.Name):
+                name = func.id
+                # Same-module def (module-level or any class's method
+                # brought into scope is NOT a thing for bare names —
+                # prefer module-level defs).
+                for qual in local.get(name, []):
+                    fn = self.functions[qual]
+                    if fn.class_name is None:
+                        targets.add(qual)
+                        targets.update(self._init_of(qual))
+                resolved = resolve_alias(name, module)
+                if resolved != name and resolved in self.functions:
+                    targets.add(resolved)
+                    targets.update(self._init_of(resolved))
+                elif resolved != name:
+                    targets.update(self._init_of(resolved))
+            elif isinstance(func, ast.Attribute):
+                attr = func.attr
+                base = _dotted(func.value)
+                resolved_base = (resolve_alias(base, module)
+                                 if base else None)
+                if base in ('self', 'cls') and info.class_name:
+                    qual = (f'{module.modname}.{info.class_name}.'
+                            f'{attr}')
+                    if qual in self.functions:
+                        targets.add(qual)
+                        continue
+                if resolved_base is not None:
+                    # Module-alias call: pkg.mod.attr / alias.attr.
+                    cand = f'{resolved_base}.{attr}'
+                    if cand in self.functions:
+                        targets.add(cand)
+                        continue
+                    init = self._init_of(cand)
+                    if init:
+                        targets.update(init)
+                        continue
+                # Fallback: any same-module method with this name
+                # (same-file over-approximation only).
+                for qual in local.get(attr, []):
+                    if self.functions[qual].class_name is not None:
+                        targets.add(qual)
+        return targets
+
+    def _init_of(self, qualname: str) -> Set[str]:
+        """qualname names a class -> its __init__ (constructor call)."""
+        init = f'{qualname}.__init__'
+        return {init} if init in self.functions else set()
+
+    # ----- queries -----------------------------------------------------------
+    def reachable_from(self, entries: Iterable[str]) -> Set[str]:
+        """Transitive closure over resolved call edges."""
+        seen: Set[str] = set()
+        stack = [e for e in entries if e in self.functions]
+        while stack:
+            cur = stack.pop()
+            if cur in seen:
+                continue
+            seen.add(cur)
+            stack.extend(self._edges.get(cur, ()))
+        return seen
+
+    def entry_points(self, marker: str = 'hot-entry',
+                     defaults: Iterable[str] = ()) -> List[str]:
+        """Functions carrying the ``# skytpu: hot-entry`` def-line
+        marker, plus any of `defaults` (qualname suffixes) present."""
+        out: Set[str] = set()
+        for qual, info in self.functions.items():
+            if info.module.marker_near(info.node, marker):
+                out.add(qual)
+            else:
+                for d in defaults:
+                    if qual == d or qual.endswith('.' + d):
+                        out.add(qual)
+        return sorted(out)

@@ -1,0 +1,70 @@
+"""db-discipline: ONE database access layer.
+
+ROADMAP item 3 swaps Postgres under the state stores by changing a
+single funnel (`utils/db_utils.py` and the four state modules it
+serves).  That swap is only a small diff while every sqlite connection
+in the tree flows through the funnel — a stray ``sqlite3.connect``
+anywhere else becomes a silent second source of truth that the
+Postgres backend will not see.  This rule pins the funnel: direct
+``sqlite3.connect`` (or holding the ``sqlite3`` import at all) is only
+legal in the allowlisted state modules.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from skypilot_tpu.analysis import callgraph as cg
+from skypilot_tpu.analysis.core import Finding, Project, Rule
+
+# The funnel Postgres will swap under (ROADMAP item 3).
+ALLOWED_FILES = (
+    'utils/db_utils.py',          # the connection funnel itself
+    'global_user_state.py',       # cluster/user state
+    'jobs/state.py',              # managed-jobs state
+    'serve/serve_state.py',       # serve services/replicas
+    'server/requests_db.py',      # API request records
+)
+
+
+class DbDisciplineRule(Rule):
+    name = 'db-discipline'
+    suppress_token = 'db'
+    description = ('direct sqlite3 use outside the state-store funnel '
+                   '(utils/db_utils.py + the four state modules)')
+
+    def check(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for module in project.modules:
+            if any(module.path.endswith(a) or module.rel.endswith(a)
+                   for a in ALLOWED_FILES):
+                continue
+            for node in ast.walk(module.tree):
+                if isinstance(node, ast.Import):
+                    for a in node.names:
+                        if a.name.split('.')[0] == 'sqlite3':
+                            findings.append(project.finding(
+                                self, module, node,
+                                'import sqlite3 outside the DB access '
+                                'layer — all connections must flow '
+                                'through utils/db_utils.py (the funnel '
+                                'the Postgres backend swaps under)'))
+                elif isinstance(node, ast.ImportFrom):
+                    if (node.module or '').split('.')[0] == 'sqlite3':
+                        findings.append(project.finding(
+                            self, module, node,
+                            'from sqlite3 import ... outside the DB '
+                            'access layer — use utils/db_utils.py'))
+                elif isinstance(node, ast.Call):
+                    dotted = cg._dotted(node.func)
+                    if dotted is None:
+                        continue
+                    resolved = cg.resolve_alias(dotted, module)
+                    if resolved.startswith('sqlite3.'):
+                        findings.append(project.finding(
+                            self, module, node,
+                            f'{resolved}(...) outside the DB access '
+                            f'layer — all sqlite goes through '
+                            f'utils/db_utils.py so ROADMAP item 3 can '
+                            f'swap Postgres under one funnel'))
+        return findings

@@ -1,0 +1,102 @@
+"""hot-loop-sync: no device->host sync on the hot loops.
+
+The decode engine's contract is exactly ONE sync per step (the
+``np.asarray(out)`` fetch); the trainer's dispatch runs ahead of the
+device and is throttled only by donated buffers.  Any additional
+``np.asarray`` / ``.item()`` / ``block_until_ready`` /
+``jax.device_get`` / ``float(jax-value)`` in a function reachable from
+those loops serializes host and device — the exact stall that caps TPU
+scaling (arXiv:2011.03641) and blows the TPOT the serve SLOs schedule
+against.  Intentional sync points carry
+``# skytpu: allow-sync(<reason>)`` at the call site.
+
+Entry points: functions marked ``# skytpu: hot-entry`` plus the known
+engine/trainer/RL loops as hardcoded backstops.  Jit-wrapped functions
+are skipped (their bodies trace once; host ops there are a trace-time
+constant, not a per-step sync).
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from skypilot_tpu.analysis import callgraph as cg
+from skypilot_tpu.analysis.core import Finding, Project, Rule
+
+# Backstop entry points (qualname suffixes) — the marker comment in the
+# source is the primary mechanism; these keep the gate honest even if a
+# marker is dropped.
+DEFAULT_ENTRY_POINTS = (
+    'skypilot_tpu.inference.engine.DecodeEngine.step',
+    'skypilot_tpu.inference.engine.DecodeEngine.step_pipelined',
+    'skypilot_tpu.inference.engine.DecodeEngine._loop',
+    'skypilot_tpu.inference.engine.DecodeEngine.drain',
+    'skypilot_tpu.train.trainer.Trainer.run',
+    'skypilot_tpu.train.rl.rollout',
+)
+
+# numpy entry points that materialize device arrays on the host.
+_NUMPY_SYNCS = ('asarray', 'array', 'copy')
+_SYNC_METHODS = ('item', 'tolist', 'block_until_ready')
+
+
+def _jaxish(node: ast.AST, module) -> bool:
+    """Does the expression mention a jax-aliased name (jnp./jax.)?"""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            resolved = cg.resolve_alias(sub.id, module)
+            if resolved == 'jax' or resolved.startswith('jax.'):
+                return True
+    return False
+
+
+class HotLoopSyncRule(Rule):
+    name = 'hot-loop-sync'
+    suppress_token = 'sync'
+    description = ('device->host syncs (np.asarray/.item()/device_get/'
+                   'block_until_ready/float-on-Array) reachable from '
+                   'the decode loop / train step / RL rollout')
+
+    def __init__(self) -> None:
+        self.entry_points_used: List[str] = []
+
+    def check(self, project: Project) -> List[Finding]:
+        graph = project.callgraph
+        entries = graph.entry_points(defaults=DEFAULT_ENTRY_POINTS)
+        self.entry_points_used = entries
+        reachable = graph.reachable_from(entries)
+        findings: List[Finding] = []
+        for qual in sorted(reachable):
+            info = graph.functions[qual]
+            if info.jit_wrapped:
+                continue
+            module = info.module
+            for call in info.calls:
+                msg = self._sync_message(call, module)
+                if msg is not None:
+                    findings.append(project.finding(
+                        self, module, call,
+                        f'{msg} in {qual} (reachable from hot entry '
+                        f'point{"s" if len(entries) > 1 else ""}) — '
+                        f'device->host sync on a hot loop'))
+        return findings
+
+    def _sync_message(self, call: ast.Call,
+                      module) -> Optional[str]:
+        func = call.func
+        dotted = cg._dotted(func)
+        if dotted is not None:
+            resolved = cg.resolve_alias(dotted, module)
+            if resolved == 'jax.device_get':
+                return 'jax.device_get(...)'
+            head, _, tail = resolved.partition('.')
+            if head == 'numpy' and tail in _NUMPY_SYNCS:
+                return f'np.{tail}(...)'
+        if isinstance(func, ast.Attribute) and \
+                func.attr in _SYNC_METHODS and not call.args:
+            return f'.{func.attr}()'
+        if isinstance(func, ast.Name) and func.id in ('float', 'int') \
+                and len(call.args) == 1 and \
+                _jaxish(call.args[0], module):
+            return f'{func.id}(<jax value>)'
+        return None

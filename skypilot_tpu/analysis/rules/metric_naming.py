@@ -1,0 +1,182 @@
+"""metric-naming: registry discipline for every exported family.
+
+server/metrics.py renders the Prometheus exposition format itself and
+the LB federates it across replicas — so naming is a cross-process
+contract: consumers (SLO autoscaler, admission control, dashboards)
+find series by name.  tests/test_observability.py asserts the
+conventions dynamically for call sites the tests happen to execute;
+this rule asserts them for EVERY call site statically:
+
+- the family name is a legal Prometheus metric name;
+- it has a ``_HELP`` entry in server/metrics.py (central registry);
+- counters end ``_total``; gauges must NOT end ``_total``;
+  histogram/summary families end ``_seconds``/``_bytes``/``_ratio``.
+
+Names are resolved statically: string literals, module-level string
+constants, and ``metrics_lib.<CONST>`` attributes (parsed out of
+server/metrics.py — nothing is imported).  Dynamically-built names are
+skipped (and are themselves a smell worth avoiding).
+"""
+from __future__ import annotations
+
+import ast
+import importlib.util
+import re
+from typing import Dict, List, Optional
+
+from skypilot_tpu.analysis import callgraph as cg
+from skypilot_tpu.analysis.core import Finding, Module, Project, Rule
+
+_METRICS_MODULE = 'skypilot_tpu.server.metrics'
+_NAME_RE = re.compile(r'^[a-zA-Z_:][a-zA-Z0-9_:]*$')
+# registration fn -> instrument kind
+_KINDS = {
+    'inc_counter': 'counter',
+    'set_gauge': 'gauge',
+    'add_gauge': 'gauge',
+    'remove_gauge': 'gauge',
+    'observe': 'summary',
+    'observe_hist': 'histogram',
+}
+
+
+def _module_constants(tree: ast.AST) -> Dict[str, str]:
+    """Module-level NAME = 'literal' assignments."""
+    out: Dict[str, str] = {}
+    for node in ast.iter_child_nodes(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                isinstance(node.value, ast.Constant) and \
+                isinstance(node.value.value, str):
+            out[node.targets[0].id] = node.value.value
+    return out
+
+
+def _help_keys(tree: ast.AST) -> Optional[set]:
+    """Keys of the _HELP dict literal in server/metrics.py."""
+    for node in ast.iter_child_nodes(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id == '_HELP' and \
+                isinstance(node.value, ast.Dict):
+            keys = set()
+            for k in node.value.keys:
+                if isinstance(k, ast.Constant) and \
+                        isinstance(k.value, str):
+                    keys.add(k.value)
+            return keys
+    return None
+
+
+def _load_metrics_ast() -> Optional[ast.AST]:
+    """Parse the installed server/metrics.py (never imported)."""
+    try:
+        spec = importlib.util.find_spec(_METRICS_MODULE)
+        if spec is None or not spec.origin:
+            return None
+        with open(spec.origin, 'r', encoding='utf-8') as f:
+            return ast.parse(f.read(), filename=spec.origin)
+    except (OSError, SyntaxError, ImportError, ValueError):
+        return None
+
+
+class MetricNamingRule(Rule):
+    name = 'metric-naming'
+    suppress_token = 'metric-naming'
+    description = ('registered metric families must satisfy the '
+                   'exposition-format conventions and have a _HELP '
+                   'entry in server/metrics.py')
+
+    def check(self, project: Project) -> List[Finding]:
+        # Prefer the metrics module from the analyzed set (so a
+        # fixture tree can ship its own); fall back to the installed
+        # one for fixture files that register against the real
+        # registry.
+        metrics_mod = project.module_by_suffix('server/metrics.py')
+        metrics_tree = metrics_mod.tree if metrics_mod else \
+            _load_metrics_ast()
+        help_keys = _help_keys(metrics_tree) if metrics_tree else None
+        metrics_consts = (_module_constants(metrics_tree)
+                          if metrics_tree else {})
+        findings: List[Finding] = []
+        for module in project.modules:
+            consts = _module_constants(module.tree)
+            for node in ast.walk(module.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                kind = self._registration_kind(node, module)
+                if kind is None:
+                    continue
+                name = self._static_name(node, module, consts,
+                                         metrics_consts)
+                if name is None:
+                    continue      # dynamic name: out of static reach
+                findings.extend(self._check_name(
+                    project, module, node, kind, name, help_keys))
+        return findings
+
+    def _registration_kind(self, call: ast.Call,
+                           module: Module) -> Optional[str]:
+        dotted = cg._dotted(call.func)
+        if dotted is None:
+            return None
+        resolved = cg.resolve_alias(dotted, module)
+        last = resolved.split('.')[-1]
+        if last not in _KINDS:
+            return None
+        # Only calls that resolve into the metrics module (via module
+        # alias or from-import) — an unrelated local `observe` is not
+        # a metric registration.
+        if resolved == f'{_METRICS_MODULE}.{last}':
+            return _KINDS[last]
+        return None
+
+    def _static_name(self, call: ast.Call, module: Module,
+                     consts: Dict[str, str],
+                     metrics_consts: Dict[str, str]) -> Optional[str]:
+        if not call.args:
+            return None
+        arg = call.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            return arg.value
+        if isinstance(arg, ast.Name):
+            return consts.get(arg.id)
+        if isinstance(arg, ast.Attribute) and \
+                isinstance(arg.value, ast.Name):
+            base = cg.resolve_alias(arg.value.id, module)
+            if base == _METRICS_MODULE:
+                return metrics_consts.get(arg.attr)
+        return None
+
+    def _check_name(self, project: Project, module: Module,
+                    node: ast.Call, kind: str, name: str,
+                    help_keys) -> List[Finding]:
+        out = []
+        if not _NAME_RE.match(name):
+            out.append(project.finding(
+                self, module, node,
+                f'metric name {name!r} is not a legal Prometheus '
+                f'metric name'))
+            return out
+        if kind == 'counter' and not name.endswith('_total'):
+            out.append(project.finding(
+                self, module, node,
+                f'counter {name!r} must end _total (exposition '
+                f'convention; federation consumers rely on it)'))
+        if kind == 'gauge' and name.endswith('_total'):
+            out.append(project.finding(
+                self, module, node,
+                f'gauge {name!r} must not end _total (that suffix '
+                f'promises a monotonic counter)'))
+        if kind in ('histogram', 'summary') and not name.endswith(
+                ('_seconds', '_bytes', '_ratio')):
+            out.append(project.finding(
+                self, module, node,
+                f'{kind} {name!r} must carry a unit suffix '
+                f'(_seconds/_bytes/_ratio)'))
+        if help_keys is not None and name not in help_keys:
+            out.append(project.finding(
+                self, module, node,
+                f'{name!r} has no _HELP entry in server/metrics.py — '
+                f'every exported family is documented centrally'))
+        return out

@@ -1,0 +1,83 @@
+"""recompile-hazard: zero mid-traffic XLA recompiles.
+
+Two hazards:
+
+1. ``jax.jit(...)`` lexically inside a ``for``/``while`` loop — a fresh
+   jit wrapper per iteration defeats the compilation cache (each
+   wrapper has its own identity) and risks a multi-second compile on a
+   per-request path.  Anywhere in the package.
+
+2. A jitted callable in a HOT module (the decode engine, trainer, RL
+   step) with neither pinned ``in_shardings``/``out_shardings`` nor
+   ``donate_argnums``: unpinned programs recompile when an input's
+   placement drifts, and undonated state doubles HBM and breaks the
+   call-k+1-reuses-call-k's-buffers invariant the zero-recompile tests
+   assert.  Intentional one-shot compiles carry
+   ``# skytpu: allow-recompile(<reason>)``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from skypilot_tpu.analysis import callgraph as cg
+from skypilot_tpu.analysis.core import (Finding, Project, Rule,
+                                        iter_non_def_descendants)
+
+_HOT_MODULES = ('inference/engine.py', 'train/trainer.py',
+                'train/rl.py', 'inference/weights.py')
+_PIN_KWARGS = ('in_shardings', 'out_shardings', 'donate_argnums',
+               'donate_argnames')
+
+
+class RecompileHazardRule(Rule):
+    name = 'recompile-hazard'
+    suppress_token = 'recompile'
+    description = ('jax.jit inside loops; hot-path jit without pinned '
+                   'shardings or donated state')
+
+    def check(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for module in project.modules:
+            hot = any(module.path.endswith(m) or module.rel.endswith(m)
+                      for m in _HOT_MODULES)
+            # Dedupe across nested loops: a jit inside `for: while:` is
+            # seen from both enclosing loops but is ONE finding.
+            seen = set()
+            for node in ast.walk(module.tree):
+                if isinstance(node, (ast.For, ast.While,
+                                     ast.AsyncFor)):
+                    for f in self._jits_in_loop(project, module, node):
+                        if (f.line, f.col) not in seen:
+                            seen.add((f.line, f.col))
+                            findings.append(f)
+            if not hot:
+                continue
+            for node in ast.walk(module.tree):
+                if isinstance(node, ast.Call) and \
+                        cg.is_jit_call(node, module) and \
+                        not self._pinned(node):
+                    findings.append(project.finding(
+                        self, module, node,
+                        'jitted hot-path callable without pinned '
+                        'in/out shardings or donated state — input '
+                        'placement drift recompiles mid-traffic and '
+                        'undonated buffers double HBM'))
+        return findings
+
+    def _jits_in_loop(self, project: Project, module,
+                      loop) -> List[Finding]:
+        out = []
+        for node in iter_non_def_descendants(loop):
+            if isinstance(node, ast.Call) and \
+                    cg.is_jit_call(node, module):
+                out.append(project.finding(
+                    self, module, node,
+                    'jax.jit(...) inside a loop — a fresh wrapper '
+                    'per iteration defeats the compile cache '
+                    '(recompile on a per-request path)'))
+        return out
+
+    @staticmethod
+    def _pinned(call: ast.Call) -> bool:
+        return any(kw.arg in _PIN_KWARGS for kw in call.keywords)

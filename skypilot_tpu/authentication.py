@@ -59,10 +59,14 @@ def _generate(priv: str) -> None:
         return
     except ImportError:
         pass
-    proc = subprocess.run(
-        ['ssh-keygen', '-t', 'ed25519', '-N', '', '-q', '-f', priv,
-         '-C', 'skytpu'],
-        capture_output=True, check=False)
+    try:
+        proc = subprocess.run(
+            ['ssh-keygen', '-t', 'ed25519', '-N', '', '-q', '-f', priv,
+             '-C', 'skytpu'],
+            capture_output=True, check=False, timeout=60)
+    except subprocess.TimeoutExpired as e:
+        raise exceptions.SkyTpuError(
+            'ssh-keygen timed out after 60s') from e
     if proc.returncode != 0:
         raise exceptions.SkyTpuError(
             f'ssh-keygen failed: {proc.stderr.decode()}')

@@ -224,8 +224,31 @@ def accelerators(name_filter):
 
 
 @cli.command()
-def check():
-    """Check cloud credentials and catalog freshness."""
+@click.argument('paths', nargs=-1,
+                type=click.Path(exists=True, dir_okay=True))
+@click.option('--json', 'as_json', is_flag=True, default=False,
+              help='Static analysis: emit the findings as JSON '
+                   '(stable schema; CI uploads it as an artifact).')
+@click.option('--rule', 'rules', multiple=True,
+              help='Static analysis: run only these rules '
+                   '(repeatable).')
+@click.option('--list-rules', is_flag=True, default=False,
+              help='Static analysis: list the rule set and exit.')
+@click.option('--show-suppressed', is_flag=True, default=False,
+              help='Static analysis: also print annotated exceptions.')
+def check(paths, as_json, rules, list_rules, show_suppressed):
+    """Cloud-credential check, or hot-path static analysis.
+
+    With no arguments: check cloud credentials and catalog freshness
+    (talks to the API server).  With PATHS or any analysis flag: run
+    the hot-path invariant analyzer (skypilot_tpu/analysis/) over the
+    given files/dirs — default: the installed skypilot_tpu package —
+    and exit non-zero on findings.  Suppress an intentional exception
+    at the call site with `# skytpu: allow-<rule>(<reason>)`.
+    """
+    if paths or rules or as_json or list_rules or show_suppressed:
+        raise SystemExit(_check_static(paths, as_json, rules,
+                                       list_rules, show_suppressed))
     result = sdk.check()
     for warning in result.pop('_warnings', []):
         click.secho(f'  WARNING: {warning}', fg='yellow', err=True)
@@ -244,6 +267,34 @@ def check():
                  f'{age}d old' + (' — STALE, refresh with '
                                   'data_fetchers' if st['stale'] else ''))
         click.echo(f'  catalog {fn}: {state}')
+
+
+def _check_static(paths, as_json, rules, list_rules,
+                  show_suppressed) -> int:
+    """`skytpu check <paths>`: run the invariant analyzer locally (no
+    server involved — this is the same gate tier-1 and CI run)."""
+    from skypilot_tpu import analysis
+    if list_rules:
+        from skypilot_tpu.analysis.rules import all_rules
+        for rule in all_rules():
+            click.echo(f'{rule.name}: {rule.description} '
+                       f'[suppress: # skytpu: allow-'
+                       f'{rule.suppress_token}(<reason>)]')
+        return 0
+    try:
+        report = analysis.run_check(paths or None, rules or None)
+    except ValueError as e:          # unknown --rule
+        click.secho(str(e), fg='red', err=True)
+        return 2
+    if as_json:
+        click.echo(analysis.render_json(report), nl=False)
+    else:
+        out = analysis.render_text(report)
+        if show_suppressed and report.suppressed:
+            lines = [f.format() for f in report.suppressed]
+            out = '\n'.join(lines) + '\n' + out
+        click.echo(out, nl=False)
+    return 1 if (report.unsuppressed or report.parse_errors) else 0
 
 
 @cli.command('rotate-keys')

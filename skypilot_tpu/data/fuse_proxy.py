@@ -30,7 +30,7 @@ def build(force: bool = False) -> str:
     shim = os.path.join(bin_dir, 'fusermount-shim')
     if force or not (os.path.exists(server) and os.path.exists(shim)):
         subprocess.run(['make', '-C', ADDON_DIR], check=True,
-                       capture_output=True)
+                       capture_output=True, timeout=600)
     return bin_dir
 
 

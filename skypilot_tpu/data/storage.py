@@ -246,6 +246,7 @@ class GcsStore(_BucketStore):
         return _fake_root()
 
     def _gsutil(self, *args: str) -> subprocess.CompletedProcess:
+        # skytpu: allow-unbounded-io(bulk upload/download: bounded by data size, not wall time)
         return subprocess.run(['gsutil', '-m', *args], check=False,
                               capture_output=True, text=True)
 
@@ -316,6 +317,7 @@ class S3Store(_BucketStore):
         return _fake_s3_root()
 
     def _aws(self, *args: str) -> subprocess.CompletedProcess:
+        # skytpu: allow-unbounded-io(bulk upload/download: bounded by data size, not wall time)
         return subprocess.run(['aws', 's3', *args], check=False,
                               capture_output=True, text=True)
 
@@ -401,6 +403,7 @@ class R2Store(S3Store):
                 'R2 needs an account endpoint: set r2.endpoint_url in '
                 'config (or SKYTPU_R2_ENDPOINT_URL), e.g. '
                 'https://<account_id>.r2.cloudflarestorage.com')
+        # skytpu: allow-unbounded-io(bulk upload/download: bounded by data size, not wall time)
         return subprocess.run(
             ['aws', 's3', '--endpoint-url', endpoint, *args],
             check=False, capture_output=True, text=True)

@@ -563,6 +563,7 @@ class DecodeEngine:
                 self._scratch_fn = jax.jit(
                     make, out_shardings=self._scratch_shardings)
             else:
+                # skytpu: allow-recompile(compiles once per engine; a creation fn has no donatable input and the scratch rides default layouts end to end)
                 self._scratch_fn = jax.jit(make)
         return self._scratch_fn(self.params)
 
@@ -1046,7 +1047,7 @@ class DecodeEngine:
         metrics_lib.set_gauge(metrics_lib.QUEUED_PREFILL_TOKENS_FAMILY,
                               float(max(sample[2], 0)))
 
-    def step(self) -> int:
+    def step(self) -> int:  # skytpu: hot-entry
         """One SYNCHRONOUS engine iteration (admit + decode + process).
         Returns #active slots.  Exposed for tests and debugging; the
         serving loop and benchmarks use step_pipelined, which overlaps
@@ -1063,12 +1064,13 @@ class DecodeEngine:
         out, self._cache, self._last_d, self._lens_d = self._decode(
             self.params, self._cache, self._last_d, self._lens_d,
             self._next_rng())
+        # skytpu: allow-sync(the ONE device->host fetch per step — the engine's contract)
         out = np.asarray(out)            # [T+1, B] — the ONE sync per step
         self._process_rows(out, {i: self._slots[i] for i in active})
         self._release_retiring()
         return len(active)
 
-    def step_pipelined(self) -> int:
+    def step_pipelined(self) -> int:  # skytpu: hot-entry
         """One PIPELINED iteration: dispatch decode call k, THEN sync and
         process call k-1's output while k runs on device, then admit
         into any slots k-1 freed (their prefills queue behind k).
@@ -1109,6 +1111,7 @@ class DecodeEngine:
         if self._inflight is not None:
             out_prev, snapshot = self._inflight
             self._inflight = None
+            # skytpu: allow-sync(the ONE fetch per step, one call late: syncs call k-1 while call k runs)
             self._process_rows(np.asarray(out_prev), snapshot)
         self._release_retiring()
         self._inflight = dispatched
@@ -1165,7 +1168,7 @@ class DecodeEngine:
                                     float(emitted))
 
 
-    def _loop(self):
+    def _loop(self):  # skytpu: hot-entry
         while not self._stop.is_set():
             try:
                 n = self.step_pipelined()

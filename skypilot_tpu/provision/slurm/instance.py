@@ -52,8 +52,17 @@ _STATE_MAP = {
 
 
 def _run(argv: List[str]) -> str:
-    proc = subprocess.run(argv, capture_output=True, text=True,
-                          check=False)
+    # A wedged slurmctld must fail the provision attempt (and feed the
+    # failover engine, which only catches ProvisionError subclasses),
+    # not hang the controller tick forever.
+    try:
+        proc = subprocess.run(argv, capture_output=True, text=True,
+                              check=False,
+                              timeout=float(os.environ.get(
+                                  'SKYTPU_SLURM_CMD_TIMEOUT_S', '120')))
+    except subprocess.TimeoutExpired as e:
+        raise exceptions.ProvisionError(
+            f'slurm command timed out: {" ".join(argv)}') from e
     if proc.returncode != 0:
         msg = (proc.stderr or proc.stdout).strip()
         low = msg.lower()

@@ -51,6 +51,14 @@ _FEDERATE_TIMEOUT_SECONDS = 2.0
 # Advisory client back-off when no replica is ready (matches the
 # controller tick that could bring one up).
 _RETRY_AFTER_SECONDS = 5
+# Upstream proxy bounds: no TOTAL deadline (streaming completions run
+# for minutes legitimately), but a replica that goes silent this long
+# mid-response is dead — fail the proxy call 502 so the client can
+# retry instead of hanging forever on a wedged socket.  The bound
+# comfortably exceeds the worst legitimate first-byte gap (a chunked
+# 128k prefill on a saturated engine; TTFT buckets extend to 120 s).
+_UPSTREAM_CONNECT_TIMEOUT_SECONDS = 10.0
+_UPSTREAM_IDLE_TIMEOUT_SECONDS = 300.0
 # Engine backlog header replicas attach to proxied responses
 # (inference/server.py): queued prefill tokens, read here for free on
 # the response path — no extra round trip.
@@ -294,7 +302,12 @@ class LoadBalancer:
             async with self._session.request(
                     request.method, target, headers=headers,
                     data=body if body else None,
-                    allow_redirects=False) as upstream:
+                    allow_redirects=False,
+                    timeout=aiohttp.ClientTimeout(
+                        total=None,
+                        sock_connect=_UPSTREAM_CONNECT_TIMEOUT_SECONDS,
+                        sock_read=_UPSTREAM_IDLE_TIMEOUT_SECONDS,
+                    )) as upstream:
                 code = str(upstream.status)
                 backlog_raw = upstream.headers.get(BACKLOG_HEADER)
                 if backlog_raw is not None:

@@ -30,8 +30,16 @@ _SAMPLE_RE = re.compile(
 _LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
 
 
+_UNESCAPE_RE = re.compile(r'\\(.)')
+_UNESCAPES = {'n': '\n', '"': '"', '\\': '\\'}
+
+
 def _unescape(v: str) -> str:
-    return v.replace('\\n', '\n').replace('\\"', '"').replace('\\\\', '\\')
+    # Single left-to-right pass: sequential str.replace corrupts values
+    # where an escaped backslash precedes 'n' or '"' (r'\\n' must yield
+    # '\' + 'n', not a newline).
+    return _UNESCAPE_RE.sub(
+        lambda m: _UNESCAPES.get(m.group(1), m.group(0)), v)
 
 
 def parse_samples(text: str) -> List[Tuple[str, Dict[str, str], float]]:

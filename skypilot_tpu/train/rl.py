@@ -72,6 +72,7 @@ def reinforce_loss(logits: jax.Array, tokens: jax.Array,
 def whiten(rewards: np.ndarray) -> np.ndarray:
     """Standard advantage whitening (mean 0, std 1; std floor for the
     all-equal case)."""
+    # skytpu: allow-sync(rewards are host floats from reward_fn — np here is host math, nothing device-side)
     rewards = np.asarray(rewards, np.float32)
     return (rewards - rewards.mean()) / max(float(rewards.std()), 1e-6)
 
@@ -96,10 +97,19 @@ def make_reinforce_step(model, tx, kl_coef: float = 0.0):
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
-    return jax.jit(step)
+    # Donate opt_state (fresh buffers from tx.init, learner-private,
+    # rebound every update): XLA reuses the Adam moments in place —
+    # 2x param bytes a 7B learner no longer holds twice mid-update.
+    # Params are deliberately NOT donated: in the co-located
+    # actor-learner mode the serving engine's tree may ALIAS this one
+    # (DecodeEngine's device_put is zero-copy when placement matches),
+    # and donating would delete buffers the decode loop still
+    # dispatches against between rollout and update_params.
+    return jax.jit(step, donate_argnums=(1,))
 
 
-def rollout(engine, prompts: List[List[int]], max_new_tokens: int,
+def rollout(engine, prompts: List[List[int]],  # skytpu: hot-entry
+            max_new_tokens: int,
             reward_fn: Callable[[List[int], List[int]], float]
             ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
     """Sample continuations on the decode engine and score them.
@@ -119,7 +129,9 @@ def rollout(engine, prompts: List[List[int]], max_new_tokens: int,
     # dispatch boundary while serving continues (double-buffered swap).
     sampled = [r.tokens() for r in reqs]
     rewards = [reward_fn(p, s) for p, s in zip(prompts, sampled)]
+    # skytpu: allow-sync(host-side batch assembly AFTER the rollout finished — tokens already left the device via the engine's one-sync-per-step fetch)
     prompt_lens = np.asarray([len(p) for p in prompts], np.int32)
+    # skytpu: allow-sync(same: host lists only, the device is not involved)
     total_lens = np.asarray(
         [len(p) + len(s) for p, s in zip(prompts, sampled)], np.int32)
     tokens = np.zeros((len(prompts), int(total_lens.max())), np.int32)

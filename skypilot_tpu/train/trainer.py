@@ -157,7 +157,8 @@ class Trainer:
         self.state = self._ckpt_mgr.restore(step, self.state)
         return step
 
-    def run(self, data: Iterator[jax.Array], num_steps: int,
+    def run(self, data: Iterator[jax.Array],  # skytpu: hot-entry
+            num_steps: int,
             checkpoint_every: int = 0,
             log_every: int = 10,
             log_fn: Callable[[dict], None] = None) -> dict:
@@ -202,6 +203,7 @@ class Trainer:
                     window_tokens / (time.perf_counter() - window_start),
                     batch)
                 if log_fn:
+                    # skytpu: allow-sync(log-boundary read only, and the fetch is of an ALREADY-retired step's metrics — dispatch stays ahead)
                     m = jax.device_get(metrics)
                     m['tokens_per_s'] = tokens_seen / (
                         time.perf_counter() - t0)
@@ -212,6 +214,7 @@ class Trainer:
             # save attributed to the next step would spike the step-time
             # p99 every checkpoint interval.
             prev = time.perf_counter()
+        # skytpu: allow-sync(end of run: the final metrics fetch, after the last step)
         out = jax.device_get(metrics)
         out['tokens_per_s'] = tokens_seen / (time.perf_counter() - t0)
         if window_tokens:
@@ -242,5 +245,6 @@ class Trainer:
 
     def save_checkpoint(self) -> None:
         if self._ckpt_mgr is not None:
+            # skytpu: allow-sync(checkpoint boundary: orbax serializes the whole tree anyway — the step read adds nothing)
             self._ckpt_mgr.save(int(jax.device_get(self.state.step)),
                                 self.state)

@@ -184,6 +184,7 @@ class LocalProcessRunner(CommandRunner):
             argv = ['rsync', '-a', '--delete']
             for pattern in excludes or []:
                 argv += ['--exclude', pattern]
+            # skytpu: allow-unbounded-io(workdir rsync: bounded by tree size, not wall time)
             rc = subprocess.run(argv + [src, dst],
                                 capture_output=True, check=False)
             if rc.returncode != 0:
@@ -294,6 +295,7 @@ class SSHCommandRunner(CommandRunner):
         argv = ['rsync', '-a', '--delete', '-e', ssh_cmd]
         for pattern in excludes or []:
             argv += ['--exclude', pattern]
+        # skytpu: allow-unbounded-io(workdir rsync over SSH: bounded by tree size, not wall time)
         rc = subprocess.run(
             argv + [src, dst],
             capture_output=True, check=False)

@@ -1,0 +1,7 @@
+"""Known-bad: direct sqlite outside the state-store funnel."""
+import sqlite3                       # BAD: holding the import at all
+
+
+def read_state(path):
+    conn = sqlite3.connect(path)     # BAD: second source of truth
+    return conn.execute('SELECT 1').fetchone()

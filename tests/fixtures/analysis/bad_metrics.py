@@ -1,0 +1,9 @@
+"""Known-bad: metric registrations violating the naming contract."""
+from skypilot_tpu.server import metrics as metrics_lib
+
+
+def report(n, dt):
+    metrics_lib.inc_counter('skytpu_fixture_requests')   # BAD: no _total
+    metrics_lib.set_gauge('skytpu_fixture_depth_total', n)  # BAD: _total gauge
+    metrics_lib.observe_hist('skytpu_fixture_latency', dt)  # BAD: no unit
+    metrics_lib.inc_counter('9bad-name', n)              # BAD: illegal name

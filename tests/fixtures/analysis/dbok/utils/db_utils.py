@@ -1,0 +1,7 @@
+"""Known-good: the funnel itself may hold sqlite3 (path mirrors
+utils/db_utils.py, the allowlisted DB access layer)."""
+import sqlite3
+
+
+def connect(path):
+    return sqlite3.connect(path, timeout=30.0)

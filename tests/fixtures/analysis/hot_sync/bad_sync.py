@@ -1,0 +1,34 @@
+"""Known-bad: device->host syncs reachable from a hot entry point."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def hot_loop(state):  # skytpu: hot-entry
+    out = helper_one(state)          # sync two calls away: still flagged
+    val = state.item()               # BAD: .item() on the hot loop
+    host = jax.device_get(state)     # BAD: device_get on the hot loop
+    loss = float(jnp.mean(state))    # BAD: float() on a jax value
+    state.block_until_ready()        # BAD: explicit barrier
+    return out, val, host, loss
+
+
+def helper_one(state):
+    return helper_two(state)
+
+
+def helper_two(state):
+    return np.asarray(state)         # BAD: two hops from the entry
+
+
+def unreachable_helper(state):
+    # Not reachable from any hot entry: must NOT be flagged.
+    return np.asarray(state)
+
+
+def _traced(state):
+    # jit-wrapped below: traces once, not a per-step sync.
+    return np.asarray(state)
+
+
+traced = jax.jit(_traced)

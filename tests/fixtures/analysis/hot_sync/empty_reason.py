@@ -1,0 +1,8 @@
+"""Known-bad: an allow-sync annotation WITHOUT a reason does not
+suppress — the reason is the point."""
+import numpy as np
+
+
+def hot_loop(state):  # skytpu: hot-entry
+    # skytpu: allow-sync()
+    return np.asarray(state)

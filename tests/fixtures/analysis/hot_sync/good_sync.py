@@ -1,0 +1,12 @@
+"""Known-good: the one intentional sync is annotated with a reason."""
+import numpy as np
+
+
+def hot_loop(state):  # skytpu: hot-entry
+    # skytpu: allow-sync(the one fetch per step - fixture counterpart of the engine contract)
+    out = np.asarray(state)
+    return host_math([1, 2, 3]), out
+
+
+def host_math(values):
+    return sum(values)               # no device involvement: clean

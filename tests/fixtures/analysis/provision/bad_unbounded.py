@@ -1,0 +1,19 @@
+"""Known-bad: unbounded outbound IO (path mirrors provision/)."""
+import subprocess
+
+import requests
+
+
+def poll_api(url):
+    return requests.get(url)             # BAD: no timeout
+
+
+def run_cli(argv):
+    return subprocess.run(argv, check=False)      # BAD: no timeout
+
+
+def hot_retry(url):
+    while True:                          # BAD: net call, no pacing/bound
+        resp = requests.get(url, timeout=5)
+        if resp.status_code == 200:
+            return resp

@@ -1,0 +1,24 @@
+"""Known-good: bounded IO and a paced, deadlined retry loop."""
+import subprocess
+import time
+
+import requests
+
+
+def poll_api(url):
+    return requests.get(url, timeout=10)
+
+
+def run_cli(argv):
+    return subprocess.run(argv, check=False, timeout=60)
+
+
+def paced_retry(url, timeout_s=300.0):
+    deadline = time.time() + timeout_s
+    while True:
+        resp = requests.get(url, timeout=10)
+        if resp.status_code == 200:
+            return resp
+        if time.time() > deadline:
+            raise TimeoutError(url)
+        time.sleep(2.0)

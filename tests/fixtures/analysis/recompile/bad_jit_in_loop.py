@@ -1,0 +1,22 @@
+"""Known-bad: jax.jit inside a loop — fresh wrapper per iteration.
+
+The while sits NESTED inside the for: its jit is visible from both
+enclosing loops but must count as ONE finding (dedupe regression)."""
+import jax
+
+
+def serve_requests(requests_list, fn):
+    results = []
+    for req in requests_list:
+        compiled = jax.jit(fn)       # BAD: re-wrapped per request
+        results.append(compiled(req))
+        while True:
+            step = jax.jit(fn)       # BAD: re-wrapped per iteration
+            results.append(step(None))
+            break
+    return results
+
+
+def fine(fn, xs):
+    compiled = jax.jit(fn)           # hoisted: compiled once — clean
+    return [compiled(x) for x in xs]

@@ -1,0 +1,14 @@
+"""Known-bad: a hot-module jit with neither pinned shardings nor
+donated state (path mirrors train/trainer.py so the hot-module scope
+applies)."""
+import jax
+
+
+def make_step(step):
+    return jax.jit(step)             # BAD: unpinned, undonated
+
+
+def make_step_pinned(step, shardings):
+    return jax.jit(step, in_shardings=(shardings,),
+                   out_shardings=(shardings,),
+                   donate_argnums=(0,))     # clean

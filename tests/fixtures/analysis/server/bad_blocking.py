@@ -1,0 +1,23 @@
+"""Known-bad: blocking calls on an event loop (path mirrors server/)."""
+import subprocess
+import time
+
+import requests
+
+
+async def handler(request):
+    time.sleep(1.0)                       # BAD: stalls the event loop
+    resp = requests.get('http://x/', timeout=5)   # BAD: sync HTTP
+    proc = subprocess.run(['ls'], timeout=5)      # BAD: sync child
+    return resp, proc
+
+
+async def clean_handler(request):
+    import asyncio
+    await asyncio.sleep(0.1)              # awaited: clean
+
+    def offloaded():
+        time.sleep(1.0)                   # runs on an executor: clean
+
+    loop = asyncio.get_event_loop()
+    return await loop.run_in_executor(None, offloaded)
