@@ -1,4 +1,5 @@
-"""metric-naming: registry discipline for every exported family.
+"""metric-naming: registry discipline for every exported family AND
+every flight-recorder span.
 
 server/metrics.py renders the Prometheus exposition format itself and
 the LB federates it across replicas — so naming is a cross-process
@@ -11,6 +12,14 @@ this rule asserts them for EVERY call site statically:
 - it has a ``_HELP`` entry in server/metrics.py (central registry);
 - counters end ``_total``; gauges must NOT end ``_total``;
   histogram/summary families end ``_seconds``/``_bytes``/``_ratio``.
+
+The flight recorder's span names (server/tracing.py) are the same kind
+of cross-process contract — the LB federates /debug views by span name
+and `skytpu trace`'s decomposition keys on them — so every
+``record_span``/``record_instant`` call site is held to the same bar:
+
+- the span name is legal (dotted lowercase, ``component.event``);
+- it has a ``SPAN_HELP`` entry in server/tracing.py.
 
 Names are resolved statically: string literals, module-level string
 constants, and ``metrics_lib.<CONST>`` attributes (parsed out of
@@ -28,7 +37,10 @@ from skypilot_tpu.analysis import callgraph as cg
 from skypilot_tpu.analysis.core import Finding, Module, Project, Rule
 
 _METRICS_MODULE = 'skypilot_tpu.server.metrics'
+_TRACING_MODULE = 'skypilot_tpu.server.tracing'
 _NAME_RE = re.compile(r'^[a-zA-Z_:][a-zA-Z0-9_:]*$')
+# Span names: dotted lowercase, component.event.
+_SPAN_NAME_RE = re.compile(r'^[a-z][a-z0-9_]*(\.[a-z][a-z0-9_]*)+$')
 # registration fn -> instrument kind
 _KINDS = {
     'inc_counter': 'counter',
@@ -38,6 +50,8 @@ _KINDS = {
     'observe': 'summary',
     'observe_hist': 'histogram',
 }
+# Flight-recorder registration fns (span name = 2nd positional arg).
+_SPAN_FNS = ('record_span', 'record_instant')
 
 
 def _module_constants(tree: ast.AST) -> Dict[str, str]:
@@ -52,12 +66,14 @@ def _module_constants(tree: ast.AST) -> Dict[str, str]:
     return out
 
 
-def _help_keys(tree: ast.AST) -> Optional[set]:
-    """Keys of the _HELP dict literal in server/metrics.py."""
+def _dict_keys(tree: ast.AST, var_name: str) -> Optional[set]:
+    """String keys of a module-level ``var_name = {...}`` dict literal
+    (the _HELP registry in server/metrics.py, SPAN_HELP in
+    server/tracing.py)."""
     for node in ast.iter_child_nodes(tree):
         if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
                 isinstance(node.targets[0], ast.Name) and \
-                node.targets[0].id == '_HELP' and \
+                node.targets[0].id == var_name and \
                 isinstance(node.value, ast.Dict):
             keys = set()
             for k in node.value.keys:
@@ -68,10 +84,10 @@ def _help_keys(tree: ast.AST) -> Optional[set]:
     return None
 
 
-def _load_metrics_ast() -> Optional[ast.AST]:
-    """Parse the installed server/metrics.py (never imported)."""
+def _load_module_ast(module_name: str) -> Optional[ast.AST]:
+    """Parse an installed module's source (never imported)."""
     try:
-        spec = importlib.util.find_spec(_METRICS_MODULE)
+        spec = importlib.util.find_spec(module_name)
         if spec is None or not spec.origin:
             return None
         with open(spec.origin, 'r', encoding='utf-8') as f:
@@ -85,19 +101,27 @@ class MetricNamingRule(Rule):
     suppress_token = 'metric-naming'
     description = ('registered metric families must satisfy the '
                    'exposition-format conventions and have a _HELP '
-                   'entry in server/metrics.py')
+                   'entry in server/metrics.py; flight-recorder spans '
+                   'must be legal dotted names with a SPAN_HELP entry '
+                   'in server/tracing.py')
 
     def check(self, project: Project) -> List[Finding]:
-        # Prefer the metrics module from the analyzed set (so a
-        # fixture tree can ship its own); fall back to the installed
-        # one for fixture files that register against the real
-        # registry.
+        # Prefer the metrics/tracing modules from the analyzed set (so
+        # a fixture tree can ship its own); fall back to the installed
+        # ones for fixture files that register against the real
+        # registries.
         metrics_mod = project.module_by_suffix('server/metrics.py')
         metrics_tree = metrics_mod.tree if metrics_mod else \
-            _load_metrics_ast()
-        help_keys = _help_keys(metrics_tree) if metrics_tree else None
+            _load_module_ast(_METRICS_MODULE)
+        help_keys = _dict_keys(metrics_tree, '_HELP') \
+            if metrics_tree else None
         metrics_consts = (_module_constants(metrics_tree)
                           if metrics_tree else {})
+        tracing_mod = project.module_by_suffix('server/tracing.py')
+        tracing_tree = tracing_mod.tree if tracing_mod else \
+            _load_module_ast(_TRACING_MODULE)
+        span_keys = _dict_keys(tracing_tree, 'SPAN_HELP') \
+            if tracing_tree else None
         findings: List[Finding] = []
         for module in project.modules:
             consts = _module_constants(module.tree)
@@ -105,14 +129,21 @@ class MetricNamingRule(Rule):
                 if not isinstance(node, ast.Call):
                     continue
                 kind = self._registration_kind(node, module)
-                if kind is None:
+                if kind is not None:
+                    name = self._static_name(node, module, consts,
+                                             metrics_consts, arg_idx=0)
+                    if name is None:
+                        continue  # dynamic name: out of static reach
+                    findings.extend(self._check_name(
+                        project, module, node, kind, name, help_keys))
                     continue
-                name = self._static_name(node, module, consts,
-                                         metrics_consts)
-                if name is None:
-                    continue      # dynamic name: out of static reach
-                findings.extend(self._check_name(
-                    project, module, node, kind, name, help_keys))
+                if self._is_span_registration(node, module):
+                    name = self._static_name(node, module, consts,
+                                             metrics_consts, arg_idx=1)
+                    if name is None:
+                        continue
+                    findings.extend(self._check_span_name(
+                        project, module, node, name, span_keys))
         return findings
 
     def _registration_kind(self, call: ast.Call,
@@ -131,12 +162,23 @@ class MetricNamingRule(Rule):
             return _KINDS[last]
         return None
 
+    def _is_span_registration(self, call: ast.Call,
+                              module: Module) -> bool:
+        dotted = cg._dotted(call.func)
+        if dotted is None:
+            return False
+        resolved = cg.resolve_alias(dotted, module)
+        last = resolved.split('.')[-1]
+        return last in _SPAN_FNS and \
+            resolved == f'{_TRACING_MODULE}.{last}'
+
     def _static_name(self, call: ast.Call, module: Module,
                      consts: Dict[str, str],
-                     metrics_consts: Dict[str, str]) -> Optional[str]:
-        if not call.args:
+                     metrics_consts: Dict[str, str],
+                     arg_idx: int = 0) -> Optional[str]:
+        if len(call.args) <= arg_idx:
             return None
-        arg = call.args[0]
+        arg = call.args[arg_idx]
         if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
             return arg.value
         if isinstance(arg, ast.Name):
@@ -147,6 +189,25 @@ class MetricNamingRule(Rule):
             if base == _METRICS_MODULE:
                 return metrics_consts.get(arg.attr)
         return None
+
+    def _check_span_name(self, project: Project, module: Module,
+                         node: ast.Call, name: str,
+                         span_keys) -> List[Finding]:
+        out = []
+        if not _SPAN_NAME_RE.match(name):
+            out.append(project.finding(
+                self, module, node,
+                f'{name!r} is not a legal span name (dotted lowercase '
+                f'component.event, e.g. engine.queue_wait)'))
+            return out
+        if span_keys is not None and name not in span_keys:
+            out.append(project.finding(
+                self, module, node,
+                f'span {name!r} has no SPAN_HELP entry in '
+                f'server/tracing.py — every recorded span is '
+                f'documented centrally (federation and skytpu trace '
+                f'key on these names)'))
+        return out
 
     def _check_name(self, project: Project, module: Module,
                     node: ast.Call, kind: str, name: str,

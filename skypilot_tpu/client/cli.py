@@ -297,6 +297,92 @@ def _check_static(paths, as_json, rules, list_rules,
     return 1 if (report.unsuppressed or report.parse_errors) else 0
 
 
+@cli.command('trace')
+@click.argument('request_id')
+@click.option('--endpoint', envvar='SKYTPU_TRACE_ENDPOINT',
+              default='http://127.0.0.1:8200', show_default=True,
+              help='Base URL exposing /debug/requests — a service\'s '
+                   'load balancer (federated: LB + replica spans in '
+                   'one view), a single replica, or the API server '
+                   '(jobs postmortem events).')
+@click.option('--chrome-out', type=click.Path(), default=None,
+              help='Also write the Chrome-trace/Perfetto JSON document '
+                   'to this path (open in ui.perfetto.dev or '
+                   'chrome://tracing).')
+def trace_cmd(request_id, endpoint, chrome_out):
+    """Show one request's distributed trace + TTFT decomposition.
+
+    Every response from a serve endpoint carries X-Skytpu-Request-Id
+    (client-supplied ids are honored).  The span events live in each
+    process's always-on flight recorder (bounded ring, knob
+    SKYTPU_TRACE_RING_SIZE); this fetches /debug/requests/<id> and
+    renders the timeline plus the decomposition
+    queue wait + N x prefill chunk + dispatch = measured TTFT.
+    """
+    import json as json_lib
+    import urllib.error
+    import urllib.parse
+    import urllib.request
+
+    base = endpoint.rstrip('/')
+    quoted = urllib.parse.quote(request_id, safe='')
+    url = f'{base}/debug/requests/{quoted}'
+
+    def fetch(u):
+        with urllib.request.urlopen(u, timeout=10) as resp:
+            return json_lib.load(resp)
+
+    try:
+        doc = fetch(url)
+    except urllib.error.HTTPError as e:
+        if e.code == 404:
+            raise click.ClickException(
+                f'request {request_id!r} is not in the flight recorder '
+                f'at {base} (evicted from the ring, or never seen '
+                f'there — try the service\'s load balancer endpoint)')
+        raise click.ClickException(f'{url}: HTTP {e.code}')
+    except (urllib.error.URLError, OSError) as e:
+        raise click.ClickException(f'cannot reach {base}: {e}')
+
+    events = doc.get('events', [])
+    t0 = min((e['ts'] for e in events), default=0.0)
+    click.echo(f'request {request_id} — {len(events)} span events')
+    rows = []
+    for e in events:
+        rows.append([
+            f'{(e["ts"] - t0) * 1e3:10.2f}',
+            '-' if e['dur_ms'] is None else f'{e["dur_ms"]:.2f}',
+            e['name'],
+            ' '.join(f'{k}={v}' for k, v in sorted(e['attrs'].items())
+                     if v is not None),
+        ])
+    ux_utils.print_table(['AT_MS', 'DUR_MS', 'SPAN', 'ATTRS'], rows)
+    s = doc.get('summary', {})
+    if s.get('ttft_ms') is not None:
+        chunks = s.get('prefill_chunks', 0)
+        prefill_part = (f'{chunks} x chunk {s["prefill_ms"]:.1f}'
+                        if chunks else f'prefill {s["prefill_ms"]:.1f}')
+        click.echo(
+            f'TTFT {s["ttft_ms"]:.1f} ms = '
+            f'queue {s["queue_wait_ms"]:.1f} + {prefill_part} + '
+            f'dispatch {s["dispatch_ms"]:.1f} '
+            f'(decomposed {s["decomposed_ttft_ms"]:.1f}, '
+            f'unattributed {s["unattributed_ms"]:.1f})')
+    else:
+        click.echo(f'outcome: {s.get("outcome", "unknown")} '
+                   f'(no first token recorded)')
+    if s.get('replica') is not None:
+        click.echo(f'replica: {s["replica"]}'
+                   + (f'  emitted: {s["emitted_tokens"]} tokens'
+                      if s.get('emitted_tokens') is not None else ''))
+    if chrome_out:
+        chrome = fetch(url + '?format=chrome')
+        with open(chrome_out, 'w', encoding='utf-8') as f:
+            json_lib.dump(chrome, f)
+        click.echo(f'Chrome trace written to {chrome_out} '
+                   f'(load in ui.perfetto.dev)')
+
+
 @cli.command('rotate-keys')
 def rotate_keys():
     """Rotate the framework SSH keypair across every UP cluster.

@@ -83,6 +83,7 @@ import numpy as np
 
 from skypilot_tpu import sky_logging
 from skypilot_tpu.server import metrics as metrics_lib
+from skypilot_tpu.server import tracing
 
 logger = sky_logging.init_logger(__name__)
 
@@ -122,6 +123,15 @@ class Request:
     first_token_at: Optional[float] = None
     finished_at: Optional[float] = None
     emitted: int = 0
+    # Distributed-tracing id (honored from the HTTP layer's
+    # X-Skytpu-Request-Id).  None = untraced (library-direct callers
+    # that did not opt in); the engine records flight-recorder span
+    # events only for traced requests.
+    request_id: Optional[str] = None
+    # perf_counter stamp of the END of this request's last prefill
+    # dispatch: the engine.dispatch span (prefill end -> first token)
+    # starts here, so the TTFT decomposition tiles exactly.
+    prefill_end_at: Optional[float] = None
 
     def tokens(self) -> List[int]:
         """Drain: block until the request finishes, return all tokens."""
@@ -152,12 +162,17 @@ class _Slot:
 class _ChunkedPrefill:
     """Host state of one long prompt mid-chunked-prefill: the scratch
     cache accumulating its K/V and how far into the prompt it is."""
-    __slots__ = ('request', 'scratch', 'offset')
+    __slots__ = ('request', 'scratch', 'offset', 'last_chunk_end')
 
     def __init__(self, request: Request, scratch) -> None:
         self.request = request
         self.scratch = scratch
         self.offset = 0          # prompt tokens already in the scratch
+        # perf_counter end stamp of the previous chunk dispatch: chunk
+        # span k runs [chunk k-1 end, chunk k end], so the per-chunk
+        # spans tile the whole chunked-prefill phase (the interleaved
+        # decode delay lands inside the chunk that waited behind it).
+        self.last_chunk_end: Optional[float] = None
 
 
 class DecodeEngine:
@@ -637,7 +652,8 @@ class DecodeEngine:
         return max(0, self._queued_tokens)
 
     def submit(self, prompt_ids: List[int],
-               max_new_tokens: int = 64) -> Request:
+               max_new_tokens: int = 64,
+               request_id: Optional[str] = None) -> Request:
         limit = self.max_prompt_len
         if len(prompt_ids) > limit:
             raise ValueError(
@@ -647,7 +663,8 @@ class DecodeEngine:
         cache_len = self.model.cfg.max_seq_len
         if len(prompt_ids) + max_new_tokens > cache_len:
             max_new_tokens = cache_len - len(prompt_ids)
-        req = Request(list(prompt_ids), max_new_tokens)
+        req = Request(list(prompt_ids), max_new_tokens,
+                      request_id=request_id)
         with self._submit_lock:
             if self.error is not None:
                 raise RuntimeError(
@@ -892,12 +909,24 @@ class DecodeEngine:
         lengths[n:] = lengths[0]
         slots[n:] = slots[0]
         prefill = self._prefill_for(bucket, padded_n)
+        t0 = time.perf_counter()
         self._cache, self._last_d, self._lens_d = prefill(
             self.params, self._cache, self._last_d, self._lens_d,
             jnp.asarray(tokens), jnp.asarray(lengths), jnp.asarray(slots),
             jnp.asarray(valid), self._next_rng())
+        t1 = time.perf_counter()
         for slot_id, req in group:
             self._slots[slot_id] = _Slot(req, len(req.prompt_ids))
+            if req.request_id is not None:
+                # Host-side stamps only (the dispatch is async): the
+                # spans tile [submit, prefill-dispatch end]; the
+                # engine.dispatch span picks up from prefill_end_at.
+                tracing.record_span(req.request_id, 'engine.queue_wait',
+                                    req.submitted_at, t0)
+                tracing.record_span(req.request_id, 'engine.prefill',
+                                    t0, t1, bucket=bucket, slot=slot_id,
+                                    group=len(group))
+                req.prefill_end_at = t1
         n_tokens = sum(len(r.prompt_ids) for _, r in group)
         with self._submit_lock:
             self._queued_tokens -= n_tokens
@@ -924,6 +953,12 @@ class DecodeEngine:
                 metrics_lib.ENGINE_TPOT_FAMILY,
                 (req.finished_at - req.first_token_at) /
                 (req.emitted - 1))
+        if req.request_id is not None:
+            tracing.record_instant(
+                req.request_id, 'engine.stream_end', req.finished_at,
+                emitted=req.emitted,
+                decode_s=(round(req.finished_at - req.first_token_at, 6)
+                          if req.first_token_at is not None else None))
         req.out.put(None)
         # Under handoff a successor may already occupy the index — only
         # clear the mapping when it still points at the finished slot.
@@ -992,12 +1027,25 @@ class DecodeEngine:
         prompt = cp.request.prompt_ids
         rem = len(prompt) - cp.offset
         chunk = self.cfg.prefill_buckets[-1]
+        rid = cp.request.request_id
         if rem > chunk:
+            t0 = time.perf_counter()
             buf = np.zeros((1, chunk), np.int32)
             buf[0] = prompt[cp.offset:cp.offset + chunk]
             cp.scratch = self._chunk_for(chunk)(
                 self.params, cp.scratch, jnp.asarray(buf),
                 jnp.asarray(cp.offset, jnp.int32))
+            t1 = time.perf_counter()
+            if rid is not None:
+                if cp.offset == 0:
+                    tracing.record_span(rid, 'engine.queue_wait',
+                                        cp.request.submitted_at, t0)
+                tracing.record_span(
+                    rid, 'engine.prefill_chunk',
+                    cp.last_chunk_end if cp.last_chunk_end is not None
+                    else t0,
+                    t1, offset=cp.offset, width=chunk, final=False)
+            cp.last_chunk_end = t1
             cp.offset += chunk
             done = chunk
         else:
@@ -1006,6 +1054,7 @@ class DecodeEngine:
             if slot_id is None:
                 return False             # all slots busy: retry later
             bucket = self._bucket(rem)
+            t0 = time.perf_counter()
             buf = np.zeros((1, bucket), np.int32)
             buf[0, :rem] = prompt[cp.offset:]
             (self._cache, self._last_d,
@@ -1016,6 +1065,20 @@ class DecodeEngine:
                  jnp.asarray(cp.offset, jnp.int32),
                  jnp.asarray(len(prompt), jnp.int32),
                  jnp.asarray(slot_id, jnp.int32), self._next_rng())
+            t1 = time.perf_counter()
+            if rid is not None:
+                # queue_wait was recorded by the FIRST chunk, which is
+                # always an intermediate one (only prompts longer than
+                # the largest bucket chunk, so rem > chunk at offset
+                # 0).  The final-chunk span includes any wait for a
+                # free slot.
+                tracing.record_span(
+                    rid, 'engine.prefill_chunk',
+                    cp.last_chunk_end if cp.last_chunk_end is not None
+                    else t0,
+                    t1, offset=cp.offset, width=bucket, final=True,
+                    slot=slot_id)
+                cp.request.prefill_end_at = t1
             self._slots[slot_id] = _Slot(cp.request, len(prompt))
             self._chunked = None
             done = rem
@@ -1153,6 +1216,24 @@ class DecodeEngine:
                 metrics_lib.observe_hist(
                     metrics_lib.ENGINE_TTFT_FAMILY,
                     now - slot.request.submitted_at)
+                rid = slot.request.request_id
+                if rid is not None:
+                    # The decode call the first token rode: from the
+                    # prefill dispatch's end to the host observing the
+                    # token — closes the TTFT tiling.
+                    tracing.record_span(
+                        rid, 'engine.dispatch',
+                        slot.request.prefill_end_at
+                        if slot.request.prefill_end_at is not None
+                        else slot.request.submitted_at,
+                        now, slot=i)
+                    # Decode-batch membership + the measured TTFT the
+                    # decomposition is checked against.
+                    tracing.record_instant(
+                        rid, 'engine.first_token', now, slot=i,
+                        batch=len(snapshot),
+                        ttft_s=round(now - slot.request.submitted_at,
+                                     6))
             else:
                 start = 1                # row 0 was emitted last step
             for t in range(start, out.shape[0]):

@@ -18,11 +18,22 @@ launched by examples/tpu/v6e/serve-llama2-7b.yaml).  Routes:
                         max_prompt_len, default max_seq_len - 1); a
                         prompt beyond that limit gets 413 with the
                         limit in the body.
+- GET  /debug/requests        -> flight-recorder summaries (recent
+                         request ids + their span names).
+- GET  /debug/requests/<id>   -> one request's span events + TTFT
+                         decomposition (`?format=chrome` exports the
+                         Chrome-trace/Perfetto document).  This is what
+                         `skytpu trace <id>` renders.
 
 Every response carries `X-Skytpu-Queued-Prefill-Tokens` (the engine's
 queued-prefill-token backlog — same value as the gauge): the serve LB
 reads it for free on the proxy path and feeds queue-aware admission
-control and least_load routing.
+control and least_load routing.  Every response also carries
+`X-Skytpu-Request-Id` — honored from the request when the client (or
+the serve LB, which mints one at admission) sent it, minted here
+otherwise — and the id keys the request's span events in the always-on
+flight recorder (server/tracing.py; ring size via
+SKYTPU_TRACE_RING_SIZE).
 
 Text prompts use a byte-level tokenizer (token id = byte value), which is
 model-agnostic and dependency-free; real deployments pass `prompt_ids`
@@ -40,6 +51,7 @@ from aiohttp import web
 from skypilot_tpu import sky_logging
 from skypilot_tpu.inference.engine import DecodeEngine, EngineConfig
 from skypilot_tpu.server import metrics as metrics_lib
+from skypilot_tpu.server import tracing
 
 logger = sky_logging.init_logger(__name__)
 
@@ -64,8 +76,16 @@ def build_app(engine: DecodeEngine) -> web.Application:
 
     @web.middleware
     async def stamp_backlog(request: web.Request, handler):
+        # Honor the caller's request id (the serve LB mints one at
+        # admission) or mint one here, so every request is traceable
+        # even library-direct; stamped on the response so the client
+        # always learns the id to `skytpu trace`.
+        rid = request.headers.get(tracing.TRACE_HEADER) or \
+            tracing.mint_request_id()
+        request['skytpu_request_id'] = rid
         resp = await handler(request)
         resp.headers[BACKLOG_HEADER] = str(engine.queued_prefill_tokens)
+        resp.headers[tracing.TRACE_HEADER] = rid
         return resp
 
     app = web.Application(middlewares=[stamp_backlog])
@@ -90,13 +110,17 @@ def build_app(engine: DecodeEngine) -> web.Application:
                     {'error': 'need "prompt" or "prompt_ids"'}, status=400)
             ids = encode_bytes(prompt)
         max_tokens = int(body.get('max_tokens', 64))
+        rid = request['skytpu_request_id']
         try:
-            req = engine.submit(ids, max_tokens)
+            req = engine.submit(ids, max_tokens, request_id=rid)
         except ValueError as e:
             # Admission rejection: the prompt exceeds max_prompt_len
             # (engine message carries the limit).  413, not 400 — the
             # request was well-formed, just too large; clients can read
             # the limit and re-chunk.
+            tracing.record_instant(rid, 'server.reject', status=413,
+                                   prompt_tokens=len(ids),
+                                   max_prompt_len=engine.max_prompt_len)
             return web.json_response(
                 {'error': str(e),
                  'max_prompt_len': engine.max_prompt_len}, status=413)
@@ -105,6 +129,7 @@ def build_app(engine: DecodeEngine) -> web.Application:
         return web.json_response({
             'ids': out,
             'text': decode_bytes(out),
+            'request_id': rid,
             'usage': {
                 'prompt_tokens': len(ids),
                 'completion_tokens': len(out),
@@ -118,8 +143,12 @@ def build_app(engine: DecodeEngine) -> web.Application:
         return web.Response(text=metrics_lib.render(),
                             content_type='text/plain')
 
+    debug_requests, debug_request = tracing.make_debug_handlers()
+
     app.router.add_get('/health', health)
     app.router.add_get('/metrics', metrics_route)
+    app.router.add_get('/debug/requests', debug_requests)
+    app.router.add_get('/debug/requests/{request_id}', debug_request)
     app.router.add_post('/v1/completions', completions)
     return app
 

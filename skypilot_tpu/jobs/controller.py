@@ -43,6 +43,7 @@ from skypilot_tpu.jobs import state
 from skypilot_tpu.jobs.recovery_strategy import StrategyExecutor
 from skypilot_tpu.jobs.state import ManagedJobStatus
 from skypilot_tpu.server import metrics as metrics_lib
+from skypilot_tpu.server import tracing
 
 logger = sky_logging.init_logger(__name__)
 
@@ -287,6 +288,10 @@ class JobController:
                     n = state.bump_recovery_count(job_id)
                     metrics_lib.inc_counter('skytpu_jobs_recoveries_total',
                                             reason='lost_job')
+                    tracing.record_instant(f'job-{job_id}',
+                                           'jobs.recovery',
+                                           reason='lost_job', attempt=n,
+                                           cluster=cluster_name)
                     logger.warning(
                         f'Managed job {job_id}: cluster {cluster_name!r} '
                         f'is UP but its agent has no record of job '
@@ -305,6 +310,16 @@ class JobController:
                 metrics_lib.inc_counter('skytpu_jobs_preemptions_total')
                 metrics_lib.inc_counter('skytpu_jobs_recoveries_total',
                                         reason='preemption')
+                # Flight-recorder postmortem trail: the controller's
+                # /debug dump explains a crashed job even after its
+                # cluster is gone.
+                tracing.record_instant(f'job-{job_id}',
+                                       'jobs.preemption',
+                                       cluster=cluster_name,
+                                       cluster_status=str(cl_status))
+                tracing.record_instant(f'job-{job_id}', 'jobs.recovery',
+                                       reason='preemption', attempt=n,
+                                       cluster=cluster_name)
                 logger.warning(
                     f'Managed job {job_id}: cluster {cluster_name!r} '
                     f'lost (status={cl_status}); recovery #{n}.')
@@ -344,6 +359,9 @@ class JobController:
                     return _TaskOutcome.FAILED
                 metrics_lib.inc_counter('skytpu_jobs_recoveries_total',
                                         reason='user_failure')
+                tracing.record_instant(f'job-{job_id}', 'jobs.recovery',
+                                       reason='user_failure', attempt=n,
+                                       cluster=cluster_name)
                 logger.info(
                     f'Managed job {job_id}: user-code failure, '
                     f'restart {n}/{max_restarts}.')

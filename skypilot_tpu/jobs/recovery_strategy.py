@@ -93,8 +93,12 @@ class StrategyExecutor:
         (the controller maps that to FAILED_NO_RESOURCE).
         """
         from skypilot_tpu.server import metrics as metrics_lib
+        from skypilot_tpu.server import tracing
         metrics_lib.inc_counter('skytpu_jobs_recovery_launches_total',
                                 strategy=self.strategy.value)
+        tracing.record_instant(f'cluster-{self.cluster_name}',
+                               'jobs.recovery_launch',
+                               strategy=self.strategy.value)
         record = global_user_state.get_cluster(self.cluster_name)
         if record is not None:
             if self.strategy is StrategyName.EAGER_FAILOVER:

@@ -13,6 +13,16 @@ the LB is handled locally and FEDERATES: it scrapes each ready replica's
 one scrape observes the whole service (engine TTFT/TPOT histograms
 included).
 
+Request tracing: the LB is where a request's distributed trace BEGINS —
+it honors the client's `X-Skytpu-Request-Id` or mints one at admission,
+records admission/routing-decision/proxy/shed span events into the
+process's always-on flight recorder (server/tracing.py), and forwards
+the id to the replica so the engine's span events share the key.  GET
+/debug/requests[/<id>] on the LB FEDERATES: it merges its own recorder
+events with each ready replica's /debug view (the same pattern as the
+/metrics federation), so one query shows LB admission + routing + the
+engine's queue/prefill-chunk/first-token decomposition end to end.
+
 Queue-aware admission control: the LB keeps a per-replica view of the
 engine's queued-prefill-token backlog — updated for free from the
 X-Skytpu-Queued-Prefill-Tokens header replicas attach to every proxied
@@ -31,6 +41,7 @@ import asyncio
 import math
 import threading
 import time
+import urllib.parse
 from typing import Callable, List, Optional, Tuple
 
 import aiohttp
@@ -40,6 +51,7 @@ from skypilot_tpu import sky_logging
 from skypilot_tpu.serve.load_balancing_policies import (
     BACKLOG_STALENESS_SECONDS, LoadBalancingPolicy)
 from skypilot_tpu.server import metrics as metrics_lib
+from skypilot_tpu.server import tracing
 
 logger = sky_logging.init_logger(__name__)
 
@@ -112,6 +124,19 @@ class LoadBalancer:
         # and an in-flight guard, rate-limiting the shed-path re-scrape.
         self._backlog_refresh_at = -1e18
         self._backlog_refreshing = False
+        # url -> monotonic time of the last SUCCESSFUL /metrics scrape
+        # of that replica, feeding the skytpu_lb_scrape_age_seconds
+        # gauge: when the SLO autoscaler decides on a stale federated
+        # window (dark scrape expiry, PR 9), dashboards can now see it.
+        self._scrape_ok_at: dict = {}
+        # url -> monotonic time it entered the ready set: the age
+        # baseline for a replica with no successful scrape yet.
+        self._ready_since: dict = {}
+        # url -> replica label the age gauge was last exported under,
+        # so a departed replica's gauge can be removed (a stale age
+        # series would read as a permanently-dark replica).
+        self._scrape_age_labels: dict = {}
+        self._started_mono = time.monotonic()
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
         self._started = threading.Event()
@@ -140,8 +165,26 @@ class LoadBalancer:
         current = frozenset(urls)
         if current != self._last_ready_set:
             self._last_ready_set = current
+            now = time.monotonic()
+            for u in current:
+                # Age baseline for a replica never successfully scraped
+                # is its JOIN time — a fresh replica must not inherit
+                # the LB's whole uptime as its "dark" age.
+                self._ready_since.setdefault(u, now)
             for stale in [u for u in self._backlog if u not in current]:
                 del self._backlog[stale]
+            for stale in [u for u in self._ready_since
+                          if u not in current]:
+                del self._ready_since[stale]
+            for stale in [u for u in self._scrape_ok_at
+                          if u not in current]:
+                del self._scrape_ok_at[stale]
+            for stale in [u for u in self._scrape_age_labels
+                          if u not in current]:
+                metrics_lib.remove_gauge(
+                    'skytpu_lb_scrape_age_seconds',
+                    service=self.service_name,
+                    replica=self._scrape_age_labels.pop(stale))
             self.policy.prune(current)
         return urls, labels
 
@@ -214,6 +257,8 @@ class LoadBalancer:
                                     total=_FEDERATE_TIMEOUT_SECONDS)
                         ) as resp:
                             if resp.status == 200:
+                                self._scrape_ok_at[url] = \
+                                    time.monotonic()
                                 self._note_backlog_from_exposition(
                                     url, await resp.text())
                     except (aiohttp.ClientError, asyncio.TimeoutError,
@@ -250,6 +295,15 @@ class LoadBalancer:
     # ----- data plane ---------------------------------------------------------
     async def _handle(self, request: web.Request) -> web.StreamResponse:
         self._request_count += 1
+        # Trace begins here: honor the client's request id or mint one
+        # (stamped on EVERY outcome below, so a shed/503 caller still
+        # has an id to `skytpu trace` the decision with).
+        rid = request.headers.get(tracing.TRACE_HEADER) or \
+            tracing.mint_request_id()
+        t_admit = time.perf_counter()
+        tracing.record_instant(rid, 'lb.admission', t_admit,
+                               service=self.service_name,
+                               path=str(request.rel_url))
         urls, labels = self._ready()
         excess = self._shed_excess_tokens(urls)
         if excess is not None:
@@ -268,11 +322,15 @@ class LoadBalancer:
             metrics_lib.inc_counter('skytpu_lb_requests_total',
                                     service=self.service_name,
                                     replica='none', code='429')
+            tracing.record_instant(rid, 'lb.shed',
+                                   retry_after_s=retry_after,
+                                   excess_tokens=round(excess, 1))
             return web.json_response(
                 {'error': f'service {self.service_name} over queue '
                           f'limit; retry after {retry_after}s'},
                 status=429,
-                headers={'Retry-After': str(retry_after)})
+                headers={'Retry-After': str(retry_after),
+                         tracing.TRACE_HEADER: rid})
         url = self.policy.select(urls)
         if url is None:
             metrics_lib.inc_counter('skytpu_lb_no_ready_replicas_total',
@@ -284,12 +342,22 @@ class LoadBalancer:
             metrics_lib.inc_counter('skytpu_lb_requests_total',
                                     service=self.service_name,
                                     replica='none', code='503')
+            tracing.record_instant(rid, 'lb.no_ready_replicas')
             return web.json_response(
                 {'error': f'no ready replicas for {self.service_name}'},
                 status=503,
-                headers={'Retry-After': str(_RETRY_AFTER_SECONDS)})
+                headers={'Retry-After': str(_RETRY_AFTER_SECONDS),
+                         tracing.TRACE_HEADER: rid})
         target = url.rstrip('/') + '/' + str(request.rel_url).lstrip('/')
         replica = labels.get(url, url)
+        # Routing decision + the per-replica signals it was made on
+        # (what the policy KNEW: backlog, outstanding, latency EWMA).
+        obs = self._backlog.get(url)
+        signals = {'backlog_tokens': obs[0] if obs is not None else None}
+        signals.update(self.policy.snapshot(url))
+        tracing.record_instant(
+            rid, 'lb.route', replica=str(replica),
+            ready_replicas=len(urls), **signals)
         self.policy.on_request_start(url)
         t0 = time.perf_counter()
         code = '502'
@@ -297,6 +365,9 @@ class LoadBalancer:
         try:
             headers = {k: v for k, v in request.headers.items()
                        if k.lower() not in _HOP_HEADERS}
+            # Propagate the trace id: the replica's engine spans key on
+            # it, making the LB->replica trace one request's story.
+            headers[tracing.TRACE_HEADER] = rid
             body = await request.read()
             assert self._session is not None
             async with self._session.request(
@@ -333,8 +404,11 @@ class LoadBalancer:
             code = '502'
             logger.warning(f'LB {self.service_name}: replica {url} '
                            f'errored: {e}')
+            # The id header rides EVERY outcome — a failed exchange is
+            # exactly the one the caller wants to `skytpu trace`.
             return web.json_response(
-                {'error': f'replica request failed: {e}'}, status=502)
+                {'error': f'replica request failed: {e}'}, status=502,
+                headers={tracing.TRACE_HEADER: rid})
         except OSError as e:
             # Raw OSError here is a CLIENT-side socket failure: upstream
             # I/O errors arrive wrapped as aiohttp.ClientError (caught
@@ -351,10 +425,14 @@ class LoadBalancer:
             code = '499'
             logger.debug(f'LB {self.service_name}: client aborted '
                          f'before response: {e}')
-            return web.Response(status=499)
+            return web.Response(status=499,
+                                headers={tracing.TRACE_HEADER: rid})
         finally:
-            duration_s = time.perf_counter() - t0
+            t_end = time.perf_counter()
+            duration_s = t_end - t0
             self.policy.on_request_end(url, duration_s)
+            tracing.record_span(rid, 'lb.proxy', t0, t_end,
+                                replica=str(replica), code=code)
             metrics_lib.observe_hist(
                 'skytpu_lb_request_duration_seconds',
                 duration_s,
@@ -363,19 +441,23 @@ class LoadBalancer:
                 'skytpu_lb_requests_total',
                 service=self.service_name, replica=replica, code=code)
 
+    def _replica_pairs(self) -> List[Tuple]:
+        """[(replica_label, url)] for federation, via _ready() so the
+        ready-set-change pruning (backlog, scrape-age gauges, policy
+        state) runs on federation paths too — a service scraped but
+        never proxied to must still drop departed replicas' series.
+        With no id view the label falls back to the URL (stable across
+        scrapes; a positional index would splice one replica's history
+        into another's whenever the ready set changes)."""
+        urls, labels = self._ready()
+        return [(labels.get(u, u), u) for u in urls]
+
     async def _metrics(self, _request: web.Request) -> web.Response:
         """Federated scrape: own registry + each ready replica's
         /metrics relabeled with replica="<id>".  A replica that is
         down, slow, or serving a non-exposition payload is skipped —
         one bad replica must not fail the whole service's scrape."""
-        if self._ready_replicas_fn is not None:
-            replicas = list(self._ready_replicas_fn())
-        else:
-            # No id view: label by URL (stable across scrapes and
-            # consistent with the proxy path's fallback; a positional
-            # index would splice one replica's history into another's
-            # whenever the ready set changes).
-            replicas = [(u, u) for u in self._ready_urls_fn()]
+        replicas = self._replica_pairs()
 
         async def scrape(rid, url):
             try:
@@ -386,6 +468,7 @@ class LoadBalancer:
                             total=_FEDERATE_TIMEOUT_SECONDS)) as resp:
                     if resp.status == 200:
                         text = await resp.text()
+                        self._scrape_ok_at[url] = time.monotonic()
                         self._note_backlog_from_exposition(url, text)
                         return (str(rid), text)
             except (aiohttp.ClientError, asyncio.TimeoutError,
@@ -398,9 +481,104 @@ class LoadBalancer:
         # scrape _FEDERATE_TIMEOUT_SECONDS, not timeout x replicas.
         texts = [t for t in await asyncio.gather(
             *(scrape(rid, url) for rid, url in replicas)) if t]
+        # Per-replica scrape age: how stale the federated view of each
+        # replica is RIGHT NOW (0 on a replica this scrape reached;
+        # growing while a replica scrapes dark).  PR 9's window expiry
+        # silently drops dark replicas from SLO decisions — this gauge
+        # makes that staleness visible to dashboards/alerts.
+        now = time.monotonic()
+        for rid, url in replicas:
+            ok_at = self._scrape_ok_at.get(url)
+            if ok_at is None:
+                # Never scraped successfully: dark since it JOINED (not
+                # since the LB started — a fresh replica is seconds
+                # dark, not the LB's uptime).
+                ok_at = self._ready_since.get(url, self._started_mono)
+            age = now - ok_at
+            metrics_lib.set_gauge('skytpu_lb_scrape_age_seconds',
+                                  round(age, 3),
+                                  service=self.service_name,
+                                  replica=str(rid))
+            self._scrape_age_labels[url] = str(rid)
         return web.Response(
             text=metrics_lib.merge_federated(metrics_lib.render(), texts),
             content_type='text/plain')
+
+    # ----- flight-recorder federation -----------------------------------------
+    async def _fetch_debug_json(self, url: str, path: str):
+        """GET one replica's /debug endpoint; None on any failure (a
+        dead replica must not fail the federated view)."""
+        try:
+            assert self._session is not None
+            async with self._session.get(
+                    url.rstrip('/') + path,
+                    timeout=aiohttp.ClientTimeout(
+                        total=_FEDERATE_TIMEOUT_SECONDS)) as resp:
+                if resp.status == 200:
+                    return await resp.json()
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError,
+                ValueError) as e:
+            logger.debug(f'LB {self.service_name}: debug fetch {path} '
+                         f'from {url} failed: {e}')
+        return None
+
+    async def _debug_requests(self, _request: web.Request
+                              ) -> web.Response:
+        """Federated flight-recorder index: the LB's own recent request
+        summaries merged with every ready replica's (same pattern as
+        the /metrics federation).  `events` is a LOWER BOUND (max
+        across sources): counts cannot be summed without knowing the
+        source overlap — library-direct deployments share one recorder
+        — and the per-id view (which dedupes actual events) is the
+        accurate one."""
+        replicas = self._replica_pairs()
+        docs = await asyncio.gather(
+            *(self._fetch_debug_json(url, '/debug/requests')
+              for _, url in replicas))
+        merged = {s['request_id']: dict(s)
+                  for s in tracing.recent_requests()}
+        for (rid_label, _), doc in zip(replicas, docs):
+            for s in (doc or {}).get('requests', []):
+                cur = merged.get(s['request_id'])
+                if cur is None:
+                    merged[s['request_id']] = dict(s)
+                    cur = merged[s['request_id']]
+                else:
+                    cur['first_ts'] = min(cur['first_ts'], s['first_ts'])
+                    cur['last_ts'] = max(cur['last_ts'], s['last_ts'])
+                    cur['events'] = max(cur['events'], s['events'])
+                    cur['spans'] = cur['spans'] + [
+                        n for n in s['spans'] if n not in cur['spans']]
+                cur.setdefault('replica', str(rid_label))
+        out = sorted(merged.values(), key=lambda s: s['last_ts'],
+                     reverse=True)
+        return web.json_response({'service': self.service_name,
+                                  'requests': out})
+
+    async def _debug_request(self, request: web.Request) -> web.Response:
+        """Federated per-request trace: the LB's own span events
+        (admission, routing decision, proxy) merged with the owning
+        replica's engine spans — one query answers "where did this
+        request's time go" across the whole data plane.  Deduped, so a
+        library-direct deployment (LB and replica in one process, one
+        recorder) reports each event once."""
+        rid = request.match_info['request_id']
+        replicas = self._replica_pairs()
+        quoted = urllib.parse.quote(rid, safe='')
+        docs = await asyncio.gather(
+            *(self._fetch_debug_json(url, f'/debug/requests/{quoted}')
+              for _, url in replicas))
+        events = tracing.events_for(rid)
+        for doc in docs:
+            events.extend((doc or {}).get('events', []))
+        payload = tracing.debug_request_payload(
+            rid, events=events, fmt=request.query.get('format', ''))
+        if payload is None:
+            return web.json_response(
+                {'error': f'request id {rid!r} not in any flight '
+                          f'recorder (evicted or never seen)'},
+                status=404)
+        return web.json_response(payload)
 
     # ----- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -421,9 +599,12 @@ class LoadBalancer:
         async def _start():
             self._session = aiohttp.ClientSession()
             app = web.Application()
-            # /metrics is served locally (and federates the replicas);
-            # registered before the catch-all proxy route.
+            # /metrics and /debug are served locally (and federate the
+            # replicas); registered before the catch-all proxy route.
             app.router.add_get('/metrics', self._metrics)
+            app.router.add_get('/debug/requests', self._debug_requests)
+            app.router.add_get('/debug/requests/{request_id}',
+                               self._debug_request)
             app.router.add_route('*', '/{tail:.*}', self._handle)
             runner = web.AppRunner(app)
             await runner.setup()

@@ -48,6 +48,13 @@ class LoadBalancingPolicy:
         drop."""
         del keep_urls
 
+    def snapshot(self, url: str) -> Dict[str, float]:
+        """The per-replica signals this policy ranks on, for the
+        routing-decision trace span (lb.route): what the policy KNEW
+        when it chose.  Blind policies know nothing."""
+        del url
+        return {}
+
     @staticmethod
     def make(name: str) -> 'LoadBalancingPolicy':
         impl = _POLICIES.get(name)
@@ -166,6 +173,17 @@ class LeastLoadPolicy(LoadBalancingPolicy):
         now = time.monotonic() if now is None else now
         with self._lock:
             self._backlog[url] = (max(0.0, queued_tokens), now)
+
+    def snapshot(self, url: str) -> Dict[str, float]:
+        with self._lock:
+            out: Dict[str, float] = {
+                'outstanding': self._outstanding.get(url, 0)}
+            if url in self._backlog:
+                out['backlog_tokens'] = self._backlog[url][0]
+            if url in self._ewma_latency:
+                out['latency_ewma_s'] = round(
+                    self._ewma_latency[url][0], 6)
+            return out
 
     def prune(self, keep_urls) -> None:
         keep = set(keep_urls)

@@ -281,6 +281,14 @@ def make_app() -> web.Application:
         return web.Response(text=metrics_lib.render(),
                             content_type='text/plain')
 
+    # Flight-recorder dump (server/tracing.py, shared handlers).  On
+    # the API server this is the postmortem surface for the managed-job
+    # controllers running in-process: preemption/recovery events record
+    # here, so a crashed job can be explained from one dump even after
+    # its cluster is gone.
+    from skypilot_tpu.server import tracing
+    debug_requests, debug_request = tracing.make_debug_handlers()
+
     # ----- requests ----------------------------------------------------------
     async def get_request(request):
         rec = requests_db.get(request.match_info['request_id'])
@@ -762,6 +770,8 @@ def make_app() -> web.Application:
 
     app.router.add_get('/api/health', health)
     app.router.add_get('/metrics', metrics_route)
+    app.router.add_get('/debug/requests', debug_requests)
+    app.router.add_get('/debug/requests/{request_id}', debug_request)
     app.router.add_get('/requests/{request_id}', get_request)
     app.router.add_post('/requests/{request_id}/cancel', cancel_request)
     app.router.add_get('/requests', list_requests)

@@ -84,6 +84,10 @@ _HELP = {
     'skytpu_lb_shed_total':
         'Requests shed 429 by queue-aware admission control (every '
         'ready replica over max_queue_tokens_per_replica)',
+    'skytpu_lb_scrape_age_seconds':
+        'Age of the last successful federated /metrics scrape of each '
+        'replica — the staleness of the window SLO decisions run on '
+        '(a growing age means that replica is scraping dark)',
     # ----- training -------------------------------------------------------
     'skytpu_train_step_seconds': 'Train step wall time',
     'skytpu_train_tokens_per_second':

@@ -111,6 +111,14 @@ def instant(name: str, **args: Any) -> None:
         _record(name, 'i', evt_args)
 
 
+def trace_document(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Wrap trace events into the Chrome trace-event document format
+    (loadable in chrome://tracing and Perfetto).  Shared by dump() and
+    the flight recorder's Chrome export (server/tracing.py), so every
+    trace this system emits opens in the same tooling."""
+    return {'traceEvents': list(events), 'displayTimeUnit': 'ms'}
+
+
 def dump(path: Optional[str] = None) -> Optional[str]:
     """Write accumulated events as a Chrome trace file; returns the path
     (None if tracing disabled and no explicit path given)."""
@@ -120,7 +128,7 @@ def dump(path: Optional[str] = None) -> Optional[str]:
     with _lock:
         events = list(_events)
     with open(path, 'w', encoding='utf-8') as f:
-        json.dump({'traceEvents': events, 'displayTimeUnit': 'ms'}, f)
+        json.dump(trace_document(events), f)
     return path
 
 

@@ -1,0 +1,9 @@
+"""Known-bad: flight-recorder span registrations violating the
+span-registry contract (metric-naming rule, span half)."""
+from skypilot_tpu.server import tracing
+
+
+def report(rid, t0, t1):
+    tracing.record_span(rid, 'engine.rogue_span', t0, t1)  # BAD: no SPAN_HELP
+    tracing.record_instant(rid, 'Bad-Span.Name', t0)       # BAD: illegal name
+    tracing.record_instant(rid, 'flat', t0)                # BAD: not dotted

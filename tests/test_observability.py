@@ -462,6 +462,39 @@ def test_lb_no_ready_replicas_503_retry_after():
 
 
 # ----- timeline thread ids ----------------------------------------------------
+def test_timeline_track_ids_survive_thread_ident_reuse(monkeypatch,
+                                                       tmp_path):
+    """Regression for the PR-5 TLS fix: create/join threads in a LOOP —
+    the OS aggressively reuses thread idents for sequential threads, so
+    any scheme keyed on threading.get_ident() would alias several
+    threads onto one Perfetto track.  TLS-backed ids must stay
+    distinct: one fresh sequential id per thread, no reuse."""
+    monkeypatch.setenv('SKYTPU_TIMELINE_FILE', str(tmp_path / 't.json'))
+    n = 12
+    for i in range(n):
+        t = threading.Thread(
+            target=lambda i=i: timeline.instant('churn', index=i))
+        t.start()
+        t.join(timeout=10)     # joined before the next starts: ident reuse
+    with timeline.Event('main'):
+        pass
+    data = json.loads(open(timeline.dump()).read())
+    tid_by_index = {}
+    for e in data['traceEvents']:
+        if e['name'] == 'churn':
+            tid_by_index[e['args']['index']] = e['tid']
+    assert len(tid_by_index) == n
+    # Every churned thread got its OWN track — no aliasing even though
+    # their get_ident() values almost certainly collided...
+    assert len(set(tid_by_index.values())) == n
+    # ...and ids are the small sequential ints the allocator promises
+    # (distinct from the main thread's).
+    main_tids = {e['tid'] for e in data['traceEvents']
+                 if e['name'] == 'main'}
+    all_tids = set(tid_by_index.values()) | main_tids
+    assert all_tids == set(range(n + 1))
+
+
 def test_timeline_thread_ids_stable_and_distinct(monkeypatch, tmp_path):
     monkeypatch.setenv('SKYTPU_TIMELINE_FILE', str(tmp_path / 't.json'))
     barrier = threading.Barrier(3)

@@ -100,6 +100,34 @@ def test_readme_makes_no_unmeasured_saturated_ttft_claim():
             f'{path}: expected {want}')
 
 
+def test_readme_tracing_overhead_claim_pinned():
+    """The flight recorder's "<1% throughput overhead" claim is
+    MECHANICAL, both directions: once a bench artifact carries the
+    serve.tracing scenario, its measured overhead_pct must actually be
+    under 1% (a recorder regression fails here, not in production) and
+    any numeric "recorder overhead N%" README claim must match the
+    artifact; before an artifact carries it, the README may not invent
+    a measured number."""
+    path, parsed = _latest_bench()
+    tracing = (parsed['detail'].get('serve') or {}).get('tracing')
+    with open(os.path.join(_ROOT, 'README.md'), encoding='utf-8') as f:
+        readme = ' '.join(f.read().split())
+    found = re.findall(r'recorder overhead ([0-9.]+)%', readme)
+    if not tracing or tracing.get('overhead_pct') is None:
+        assert not found, (
+            f'README claims a measured recorder overhead ({found}) but '
+            f'the latest bench artifact {path} has no tracing scenario')
+        return
+    assert tracing['overhead_pct'] < 1.0, (
+        f'{path}: flight-recorder overhead {tracing["overhead_pct"]}% '
+        f'breaks the README\'s "<1% throughput overhead" contract')
+    assert tracing['ns_per_event'] > 0
+    want = f"{tracing['overhead_pct']:.3f}"
+    assert all(v == want for v in found), (
+        f'README recorder-overhead claim {found} drifted from {path}: '
+        f'expected {want}')
+
+
 def test_readme_makes_no_unmeasured_slo_ramp_claim():
     """A numeric SLO-vs-QPS ramp claim in the README must come from the
     latest bench artifact, not be invented ahead of it."""

@@ -118,11 +118,16 @@ def test_fixture_findings_land_where_expected():
     ub = by_rule['unbounded-io']
     assert {f.path for f in ub} == {'provision/bad_unbounded.py'}
     assert sum('retry loop' in f.message for f in ub) == 1
-    # metric-naming: _total / unit-suffix / legal-name / _HELP checks.
+    # metric-naming: _total / unit-suffix / legal-name / _HELP checks,
+    # plus the span-registry half (legal dotted names, SPAN_HELP).
     mn = ' '.join(f.message for f in by_rule['metric-naming'])
     for needle in ('must end _total', 'must not end _total',
-                   'unit suffix', 'not a legal', 'no _HELP'):
+                   'unit suffix', 'not a legal', 'no _HELP',
+                   'no SPAN_HELP', 'not a legal span name'):
         assert needle in mn
+    span_hits = [f for f in by_rule['metric-naming']
+                 if f.path == 'bad_spans.py']
+    assert len(span_hits) == 3
 
 
 # ---------------------------------------------------------------------------
