@@ -47,6 +47,23 @@ indistinguishable from a bucket-admitted one.  Prompts up to
 Prompts that fit one bucket keep the fused single-dispatch path
 byte-for-byte, so short-prompt bench numbers are untouched.
 
+Paged KV + prefix caching (`kv_page_size`): the slot-contiguous cache
+becomes a page POOL [n_pages, H, page_size, D] with host-side per-slot
+page tables (inference/paging.py owns the allocator + radix trie).
+Admission charges ceil((prompt+max_new)/page) pages instead of
+reserving n_slots x max_seq_len of HBM, and requests sharing a
+page-aligned token prefix (system prompts, few-shot templates,
+multi-turn replays — retire donates prompt+generated pages) reference
+the prefilled pages instead of recomputing them: the matched pages
+gather into the chunked-prefill scratch and only the suffix prefills.
+Shared pages are never written (extension allocates fresh pages, so
+copy-on-extend is free), eviction is LRU over pages no live slot
+holds, and every contract above survives: decode stays one jitted
+dispatch + one sync per step (tables ship async, only when dirty),
+programs never recompile (tables are data, not shapes), and greedy
+output is token-identical to the unpaged engine — single-device and
+tensor-parallel (the pool shards over kv heads like the dense cache).
+
 Weight swaps (`update_params`) are double-buffered and in-flight-safe:
 the new tree is STAGED into the engine's committed layouts/shardings
 (device_put overlaps with serving), INSTALLED at the loop's next
@@ -71,6 +88,7 @@ slot bookkeeping.  `mesh=None` is the exact single-device path
 """
 from __future__ import annotations
 
+import collections
 import dataclasses
 import queue
 import threading
@@ -82,6 +100,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from skypilot_tpu import sky_logging
+from skypilot_tpu.inference.paging import TRASH_PAGE, PagePool, RadixCache
 from skypilot_tpu.server import metrics as metrics_lib
 from skypilot_tpu.server import tracing
 
@@ -111,6 +130,26 @@ class EngineConfig:
     # parallel/mesh.py build_serve_mesh).  None = single-device engine.
     mesh: Optional[Any] = None
     tensor_axis: str = 'tensor'
+    # Paged KV cache: break the slot-contiguous [n_slots, H, max_seq_len,
+    # D] cache into fixed-size pages with a per-slot page table.
+    # Admission then charges PAGES (ceil((prompt+max_new)/page) of them)
+    # instead of reserving max_seq_len per slot, and shared prompt
+    # prefixes are prefilled once and referenced by every matching
+    # request (prefix_cache below).  Must divide every prefill bucket
+    # and max_seq_len.  None = the legacy contiguous layout, unchanged.
+    kv_page_size: Optional[int] = None
+    # Page-pool size.  None = full backing (n_slots * max_seq_len /
+    # page_size, + 1 trash page): paging with zero admission risk.
+    # Deployments whose requests use less than max_seq_len set it lower
+    # — that is the HBM-per-slot win.  Must fit at least one
+    # max-length request (max_seq_len / page_size pages + trash).
+    kv_pages: Optional[int] = None
+    # Radix prefix cache over the page pool (kv_page_size set): retired
+    # and admitted sequences donate their full pages to a token-keyed
+    # radix trie; a new prompt sharing a page-aligned prefix skips its
+    # prefill and references the cached pages (LRU-evicted when the
+    # pool runs short).  Ignored without paging.
+    prefix_cache: bool = True
 
 
 @dataclasses.dataclass
@@ -132,6 +171,10 @@ class Request:
     # dispatch: the engine.dispatch span (prefill end -> first token)
     # starts here, so the TTFT decomposition tiles exactly.
     prefill_end_at: Optional[float] = None
+    # Set when a stuck-pool spill demoted this request to a full
+    # prefill: re-matching it would just re-pin the pages that starved
+    # the pool (see _spill_stuck_hits).
+    no_prefix: bool = False
 
     def tokens(self) -> List[int]:
         """Drain: block until the request finishes, return all tokens."""
@@ -144,9 +187,12 @@ class Request:
 
 
 class _Slot:
-    __slots__ = ('request', 'length', 'first_pending', 'done')
+    __slots__ = ('request', 'length', 'first_pending', 'done', 'pages',
+                 'n_shared', 'toks')
 
-    def __init__(self, request: Request, length: int) -> None:
+    def __init__(self, request: Request, length: int,
+                 pages: Optional[List[int]] = None,
+                 n_shared: int = 0) -> None:
         self.request = request
         self.length = length              # prompt len + emitted (host view)
         # True until the prefill-sampled first token has been emitted
@@ -157,22 +203,40 @@ class _Slot:
         # rows" (handoff: a successor was admitted into the slot index)
         # from "this slot's rows are retire-lag garbage".
         self.done = False
+        # Paged engine: the KV pages backing this slot, in logical page
+        # order; the first n_shared are prefix-cache pages this slot
+        # references but never writes.  Released (and the full ones
+        # donated to the radix cache) at retire.
+        self.pages = pages
+        self.n_shared = n_shared
+        # Emitted tokens (prefix_cache only): retire donates the pages
+        # covering prompt+generated, so multi-turn replays hit.
+        self.toks: List[int] = []
 
 
 class _ChunkedPrefill:
     """Host state of one long prompt mid-chunked-prefill: the scratch
-    cache accumulating its K/V and how far into the prompt it is."""
-    __slots__ = ('request', 'scratch', 'offset', 'last_chunk_end')
+    cache accumulating its K/V and how far into the prompt it is.  A
+    prefix-cache hit starts with offset == the matched length and a
+    scratch pre-seeded by gathering the shared pages."""
+    __slots__ = ('request', 'scratch', 'offset', 'last_chunk_end',
+                 'shared_pages')
 
-    def __init__(self, request: Request, scratch) -> None:
+    def __init__(self, request: Request, scratch,
+                 offset: int = 0,
+                 shared_pages: Optional[List[int]] = None) -> None:
         self.request = request
         self.scratch = scratch
-        self.offset = 0          # prompt tokens already in the scratch
+        self.offset = offset     # prompt tokens already in the scratch
         # perf_counter end stamp of the previous chunk dispatch: chunk
         # span k runs [chunk k-1 end, chunk k end], so the per-chunk
         # spans tile the whole chunked-prefill phase (the interleaved
         # decode delay lands inside the chunk that waited behind it).
         self.last_chunk_end: Optional[float] = None
+        # Prefix-cache pages this request references (already ref'd on
+        # its behalf by the match); they become the head of its slot's
+        # page table at insert time.
+        self.shared_pages = shared_pages or []
 
 
 class DecodeEngine:
@@ -185,6 +249,10 @@ class DecodeEngine:
     def __init__(self, model, params, config: EngineConfig = EngineConfig()):
         self.model = model
         self.params = params
+        if config.n_slots <= 0:
+            raise ValueError(
+                f'EngineConfig.n_slots must be a positive slot count, '
+                f'got {config.n_slots}')
         # Buckets beyond the cache length can never be inserted; drop
         # them so submit() rejects oversized prompts up front instead of
         # crashing the loop thread at dynamic_update_slice time.
@@ -193,6 +261,7 @@ class DecodeEngine:
         if not buckets:
             buckets = (max_len,)
         config = dataclasses.replace(config, prefill_buckets=buckets)
+        self._validate_paging(config, max_len)
         self.cfg = config
         self._rng = jax.random.PRNGKey(config.seed)
         self._prefill_q: 'queue.Queue[Request]' = queue.Queue()
@@ -210,6 +279,33 @@ class DecodeEngine:
         self._long_q: 'queue.Queue[Request]' = queue.Queue()
         self._chunked: Optional[_ChunkedPrefill] = None
         self._scratch_fn = None
+        # Paged KV cache (kv_page_size set): host allocator + per-slot
+        # page tables + (optionally) the radix prefix cache.  All page
+        # bookkeeping is loop-thread state; only the table itself is
+        # shipped to device (async H2D, refreshed when dirty).
+        self._paged = config.kv_page_size is not None
+        self._page_size = config.kv_page_size
+        self._pages_per_slot = (max_len // config.kv_page_size
+                                if self._paged else 0)
+        self._pool_alloc: Optional[PagePool] = None
+        self._radix: Optional[RadixCache] = None
+        self._page_tables = None        # host np [n_slots, pages_per_slot]
+        self._pt_device = None
+        self._pt_dirty = True
+        # Short prompts pulled off _prefill_q by the loop, awaiting page
+        # reservation (head-of-line on allocation failure); prefix-cache
+        # hits divert here to ride the chunk machinery.
+        self._ready_q: 'collections.deque' = collections.deque()
+        self._hit_q: 'collections.deque' = collections.deque()
+        if self._paged:
+            n_pages = (config.kv_pages if config.kv_pages is not None
+                       else config.n_slots * self._pages_per_slot + 1)
+            self._pool_alloc = PagePool(n_pages, config.kv_page_size)
+            if config.prefix_cache:
+                self._radix = RadixCache(self._pool_alloc)
+            self._page_tables = np.full(
+                (config.n_slots, self._pages_per_slot), TRASH_PAGE,
+                np.int32)
         # Prompt tokens accepted but not yet prefilled (queued requests
         # + the un-prefilled remainder of the active chunked prompt).
         # Writers hold _submit_lock; the loop's gauge read is a bare
@@ -244,7 +340,11 @@ class DecodeEngine:
         self._params_owned = self._mesh is not None
         self._build_fns()
         self._init_cache()
-        if jax.default_backend() == 'tpu' and self._mesh is None:
+        if (jax.default_backend() == 'tpu' and self._mesh is None and
+                not self._paged):
+            # The AOT layout pass is specialized to the contiguous
+            # cache; the paged pool rides default layouts (its decode
+            # gathers re-tile anyway).
             try:
                 self._optimize_layouts()
             except Exception:  # pylint: disable=broad-except
@@ -258,6 +358,37 @@ class DecodeEngine:
     @property
     def healthy(self) -> bool:
         return self.error is None
+
+    @staticmethod
+    def _validate_paging(config: EngineConfig, max_len: int) -> None:
+        """Reject paging geometry that cannot work, naming the
+        offending values: kv_page_size must divide every prefill bucket
+        and max_seq_len (page-aligned inserts and prefix matches depend
+        on it), and the pool must fit at least one max-length request
+        plus the trash page."""
+        ps = config.kv_page_size
+        if ps is None:
+            return
+        if ps <= 0:
+            raise ValueError(
+                f'kv_page_size must be a positive token count, got {ps}')
+        offending = [b for b in config.prefill_buckets if b % ps != 0]
+        if max_len % ps != 0:
+            offending.append(max_len)
+        if offending:
+            raise ValueError(
+                f'kv_page_size={ps} must divide every prefill bucket '
+                f'and max_seq_len; offending values: '
+                f'{sorted(set(offending))} (buckets='
+                f'{config.prefill_buckets}, max_seq_len={max_len})')
+        if config.kv_pages is not None:
+            need = max_len // ps + 1
+            if config.kv_pages < need:
+                raise ValueError(
+                    f'kv_pages={config.kv_pages} cannot hold one '
+                    f'max-length request: need >= {need} '
+                    f'(max_seq_len {max_len} / kv_page_size {ps} '
+                    f'+ 1 trash page)')
 
     # ----- mesh setup --------------------------------------------------------
     def _setup_mesh(self):
@@ -304,12 +435,25 @@ class DecodeEngine:
             return kv if n_kv and n_kv % tp == 0 else self._repl
 
         cache_abs = jax.eval_shape(self._make_cache, self.params)
+        if self._paged:
+            # The page pool [n_pages, n_kv_heads, page_size, head_dim]
+            # shards over the same kv-heads dim as the dense cache, so
+            # page gathers/scatters (dim 0) stay local per chip.
+            cache_abs = jax.tree.map(
+                lambda l: jax.ShapeDtypeStruct(self._pool_shape(l.shape),
+                                               l.dtype), cache_abs)
         self._cache_shardings = jax.tree.map(_kv_or_repl, cache_abs)
         # The chunked-prefill scratch cache [1, n_kv_heads, max_len, D]
         # shards over kv heads exactly like the big cache.
         scratch_abs = jax.eval_shape(lambda p: self._make_cache(p, 1),
                                      self.params)
         self._scratch_shardings = jax.tree.map(_kv_or_repl, scratch_abs)
+
+    def _pool_shape(self, dense_shape) -> tuple:
+        """Dense cache leaf [n, H, max_len, D] -> page-pool leaf
+        [n_pages, H, page_size, D]."""
+        return (self._pool_alloc.n_pages, dense_shape[1],
+                self._page_size, dense_shape[3])
 
     def _make_cache(self, params, n: Optional[int] = None):
         """Trace a dummy decode batch; returns the per-layer cache for
@@ -431,11 +575,122 @@ class DecodeEngine:
             return (big_cache, last_toks.at[slot].set(first[0]),
                     lens.at[slot].set(total_len))
 
+        # ----- paged variants ------------------------------------------------
+        # Prefill and chunked prefill still run against DENSE per-
+        # request caches (identical programs, identical numerics); only
+        # the insert tail changes — full pages scatter into the pool at
+        # the page table's physical ids — and the decode step gathers
+        # through the table inside the model (models/llama.py
+        # _paged_attend).  Page tables are host-built arrays shipped
+        # async; nothing below adds a sync.
+        ps_ = self.cfg.kv_page_size
+        n_pp = self._pages_per_slot
+
+        def _to_pages(small):
+            """Dense rows [N, H, L, D] -> page stacks [N, P, ps, H, D]
+            -> [N, P, H, ps, D] matching pool scatter trailing dims."""
+            n, h, length, d = small.shape
+            pages = small.transpose(0, 2, 1, 3).reshape(
+                n, n_pp, ps_, h, d)
+            return pages.transpose(0, 1, 3, 2, 4)
+
+        def prefill_insert_paged(params, pool, last_toks, lens, tokens,
+                                 lengths, slots, pt_rows, valid, rng):
+            """Fused batched prefill + PAGED insert: identical prefill
+            compute, then every row's full-length dense cache scatters
+            into the pool at its page-table row.  Entries past a row's
+            reservation point at the trash page (garbage there is never
+            at an unmasked position); padding rows replicate row 0's
+            table, so their duplicate writes carry identical values."""
+            n, p = tokens.shape
+            positions = jnp.broadcast_to(jnp.arange(p)[None, :], (n, p))
+            logits, cache = model.apply(
+                {'params': params}, tokens, positions=positions,
+                decode=True, mutable=['cache'])
+            last = jnp.take_along_axis(
+                logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
+            firsts = sample(last, rng)
+            firsts = jnp.where(valid.astype(bool), firsts, firsts[0])
+
+            def _ins(pool_leaf, small):
+                return pool_leaf.at[pt_rows].set(_to_pages(small))
+
+            pool = jax.tree_util.tree_map(_ins, pool, cache['cache'])
+            return (pool, last_toks.at[slots].set(firsts),
+                    lens.at[slots].set(lengths))
+
+        def decode_paged(params, pool, pt, last_tokens, lengths, rng):
+            """`steps` tokens for every slot against the page pool —
+            the model gathers/scatters through the (call-constant) page
+            table; host contract identical to the dense decode."""
+            def body(carry, rng_t):
+                pool, last, lens = carry
+                positions = jnp.minimum(lens, max_len - 1)[:, None]
+                logits, new_cache = model.apply(
+                    {'params': params, 'cache': pool},
+                    last[:, None], positions=positions,
+                    decode=True, page_table=pt, mutable=['cache'])
+                nxt = sample(logits[:, 0, :], rng_t)
+                return (new_cache['cache'], nxt, lens + 1), nxt
+
+            (pool, last, lens), toks = jax.lax.scan(
+                body, (pool, last_tokens, lengths),
+                jax.random.split(rng, steps))
+            out = jnp.concatenate([last_tokens[None, :], toks], axis=0)
+            return out, pool, last, lens
+
+        def gather_prefix(pool, pt_row):
+            """Prefix-cache hit: materialize the matched pages into a
+            DENSE scratch cache [1, H, max_len, D] so the remaining
+            prompt rides the ordinary chunked-prefill path (S > 1
+            against an existing cache) from offset = matched length.
+            Unmatched entries are trash pages — garbage strictly above
+            every query position the suffix will use."""
+            def _g(leaf):
+                g = leaf[pt_row]                  # [P, H, ps, D]
+                g = g.transpose(1, 0, 2, 3)       # [H, P, ps, D]
+                return g.reshape(1, leaf.shape[1], n_pp * ps_,
+                                 leaf.shape[3])
+
+            return jax.tree_util.tree_map(_g, pool)
+
+        def chunk_insert_paged(params, pool, last_toks, lens, scratch,
+                               tokens, length, offset, total_len, slot,
+                               pt_row, rng):
+            """Final chunk + PAGED slot insert: the dense chunk body,
+            then the accumulated scratch scatters into the pool at this
+            request's page-table row.  Shared prefix pages receive
+            value-identical write-backs (the scratch was gathered from
+            them and chunk writes land past the match), so concurrent
+            sharers never observe a change."""
+            c = tokens.shape[1]
+            positions = offset + jnp.arange(c)[None, :]
+            logits, cache = model.apply(
+                {'params': params, 'cache': scratch}, tokens,
+                positions=positions, decode=True, mutable=['cache'])
+            last = jax.lax.dynamic_index_in_dim(logits, length - 1,
+                                                axis=1, keepdims=False)
+            first = sample(last, rng)
+
+            def _ins(pool_leaf, small):
+                return pool_leaf.at[pt_row].set(_to_pages(small)[0])
+
+            pool = jax.tree_util.tree_map(_ins, pool, cache['cache'])
+            return (pool, last_toks.at[slot].set(first[0]),
+                    lens.at[slot].set(total_len))
+
+        if self._paged:
+            prefill_insert = prefill_insert_paged
+            decode = decode_paged
+            prefill_chunk_insert = chunk_insert_paged
+            self._gather_raw = gather_prefix
         self._prefill_raw = prefill_insert
         self._decode_raw = decode
         self._chunk_raw = prefill_chunk
         self._chunk_insert_raw = prefill_chunk_insert
-        if self._mesh is None:
+        if self._paged:
+            self._build_paged_jits()
+        elif self._mesh is None:
             self._prefill_insert = jax.jit(prefill_insert,
                                            donate_argnums=(1, 2, 3))
             self._decode = jax.jit(decode, donate_argnums=(1, 2, 3))
@@ -472,11 +727,54 @@ class DecodeEngine:
                 in_shardings=(p_sh, c_sh, r, r, s_sh, r, r, r, r, r, r),
                 out_shardings=(c_sh, r, r))
 
+    def _build_paged_jits(self):
+        """Jit wiring for the paged programs (the paged twin of the
+        branches in _build_fns): same donation discipline — the pool
+        rides through every program donated, so call k+1 reuses call
+        k's buffer — with the page table and gather output never
+        donated (the table is reused across calls; the pool outlives a
+        prefix gather)."""
+        if self._mesh is None:
+            self._prefill_insert = jax.jit(self._prefill_raw,
+                                           donate_argnums=(1, 2, 3))
+            self._decode = jax.jit(self._decode_raw,
+                                   donate_argnums=(1, 3, 4))
+            self._prefill_chunk = jax.jit(self._chunk_raw,
+                                          donate_argnums=(1,))
+            self._chunk_insert = jax.jit(self._chunk_insert_raw,
+                                         donate_argnums=(1, 2, 3))
+            # skytpu: allow-recompile(one fixed shape per engine; the pool is read-only here — donating it would free the live cache — and the page-table row is a tiny per-call upload)
+            self._gather_prefix = jax.jit(self._gather_raw)
+            return
+        p_sh, c_sh, r = (self._param_shardings, self._cache_shardings,
+                         self._repl)
+        s_sh = self._scratch_shardings
+        self._prefill_insert = jax.jit(
+            self._prefill_raw, donate_argnums=(1, 2, 3),
+            in_shardings=(p_sh, c_sh, r, r, r, r, r, r, r, r),
+            out_shardings=(c_sh, r, r))
+        self._decode = jax.jit(
+            self._decode_raw, donate_argnums=(1, 3, 4),
+            in_shardings=(p_sh, c_sh, r, r, r, r),
+            out_shardings=(r, c_sh, r, r))
+        self._prefill_chunk = jax.jit(
+            self._chunk_raw, donate_argnums=(1,),
+            in_shardings=(p_sh, s_sh, r, r), out_shardings=s_sh)
+        self._chunk_insert = jax.jit(
+            self._chunk_insert_raw, donate_argnums=(1, 2, 3),
+            in_shardings=(p_sh, c_sh, r, r, s_sh, r, r, r, r, r, r, r),
+            out_shardings=(c_sh, r, r))
+        self._gather_prefix = jax.jit(
+            self._gather_raw, in_shardings=(c_sh, r), out_shardings=s_sh)
+
     def _init_cache(self):
         """Materialize the big cache by tracing a dummy decode batch.
         Under a mesh it is created ALREADY sharded (jit out_shardings) —
         at no point does a full cache exist on one device."""
         n = self.cfg.n_slots
+        if self._paged:
+            self._init_pool()
+            return
         if self._mesh is None:
             self._cache = self._make_cache(self.params)
             self._last_d = jnp.zeros((n,), jnp.int32)
@@ -485,6 +783,32 @@ class DecodeEngine:
         self._cache = jax.jit(
             self._make_cache,
             out_shardings=self._cache_shardings)(self.params)
+        self._last_d = jax.device_put(jnp.zeros((n,), jnp.int32),
+                                      self._repl)
+        self._lens_d = jax.device_put(jnp.zeros((n,), jnp.int32),
+                                      self._repl)
+
+    def _init_pool(self):
+        """Materialize the PAGE POOL: the dense cache tree's shape with
+        [n_slots, ..., max_seq_len, ...] swapped for [n_pages, ...,
+        page_size, ...].  Total HBM = n_pages x page bytes — sized by
+        kv_pages, not by n_slots x max_seq_len; that delta is the
+        reservation paging removes.  Created sharded under a mesh."""
+        n = self.cfg.n_slots
+        cache_abs = jax.eval_shape(self._make_cache, self.params)
+
+        def make_pool(_params):
+            return jax.tree.map(
+                lambda l: jnp.zeros(self._pool_shape(l.shape), l.dtype),
+                cache_abs)
+
+        if self._mesh is None:
+            self._cache = make_pool(self.params)
+            self._last_d = jnp.zeros((n,), jnp.int32)
+            self._lens_d = jnp.zeros((n,), jnp.int32)
+            return
+        self._cache = jax.jit(
+            make_pool, out_shardings=self._cache_shardings)(self.params)
         self._last_d = jax.device_put(jnp.zeros((n,), jnp.int32),
                                       self._repl)
         self._lens_d = jax.device_put(jnp.zeros((n,), jnp.int32),
@@ -691,6 +1015,7 @@ class DecodeEngine:
         while (self._inflight is not None or
                not self._prefill_q.empty() or
                not self._long_q.empty() or
+               self._ready_q or self._hit_q or
                self._chunked is not None or
                any(s is not None for s in self._slots)):
             self.step_pipelined()
@@ -827,19 +1152,34 @@ class DecodeEngine:
         scribbled — harmless, an insert overwrites a slot wholesale and
         no slot is active to read them.
         """
+        trash_row = (jnp.full((self._pages_per_slot,), TRASH_PAGE,
+                              jnp.int32) if self._paged else None)
         for bucket in self.cfg.prefill_buckets:
             for size in self._prewarm_sizes():
                 tokens = jnp.zeros((size, bucket), jnp.int32)
                 ones = jnp.ones((size,), jnp.int32)
                 zeros = jnp.zeros((size,), jnp.int32)
-                (self._cache, self._last_d,
-                 self._lens_d) = self._prefill_insert(
-                     self.params, self._cache, self._last_d, self._lens_d,
-                     tokens, ones, zeros, zeros, self._next_rng())
-        if self._chunking_possible():
+                if self._paged:
+                    rows = jnp.broadcast_to(trash_row[None, :],
+                                            (size, self._pages_per_slot))
+                    (self._cache, self._last_d,
+                     self._lens_d) = self._prefill_insert(
+                         self.params, self._cache, self._last_d,
+                         self._lens_d, tokens, ones, zeros, rows, zeros,
+                         self._next_rng())
+                else:
+                    (self._cache, self._last_d,
+                     self._lens_d) = self._prefill_insert(
+                         self.params, self._cache, self._last_d,
+                         self._lens_d, tokens, ones, zeros, zeros,
+                         self._next_rng())
+        if self._chunking_possible() or (self._paged and
+                                         self._radix is not None):
             # Chunked-prefill shapes: one intermediate-chunk program
-            # (largest bucket) + one final-insert program per bucket.
-            # Dummy dispatches scribble slot 0 like the loop above.
+            # (largest bucket) + one final-insert program per bucket
+            # (the prefix-cache hit path rides them even when no prompt
+            # exceeds the largest bucket).  Dummy dispatches scribble
+            # slot 0 / the trash page like the loop above.
             chunk = self.cfg.prefill_buckets[-1]
             one = jnp.ones((), jnp.int32)
             zero = jnp.zeros((), jnp.int32)
@@ -847,15 +1187,30 @@ class DecodeEngine:
                 scratch = self._prefill_chunk(
                     self.params, self._new_scratch(),
                     jnp.zeros((1, chunk), jnp.int32), zero)
-                (self._cache, self._last_d,
-                 self._lens_d) = self._chunk_insert(
-                     self.params, self._cache, self._last_d,
-                     self._lens_d, scratch,
-                     jnp.zeros((1, bucket), jnp.int32), one, zero, one,
-                     zero, self._next_rng())
-        _, self._cache, self._last_d, self._lens_d = self._decode(
-            self.params, self._cache, self._last_d, self._lens_d,
-            self._next_rng())
+                if self._paged:
+                    (self._cache, self._last_d,
+                     self._lens_d) = self._chunk_insert(
+                         self.params, self._cache, self._last_d,
+                         self._lens_d, scratch,
+                         jnp.zeros((1, bucket), jnp.int32), one, zero,
+                         one, zero, trash_row, self._next_rng())
+                else:
+                    (self._cache, self._last_d,
+                     self._lens_d) = self._chunk_insert(
+                         self.params, self._cache, self._last_d,
+                         self._lens_d, scratch,
+                         jnp.zeros((1, bucket), jnp.int32), one, zero,
+                         one, zero, self._next_rng())
+        if self._paged and self._radix is not None:
+            self._gather_prefix(self._cache, trash_row)
+        if self._paged:
+            _, self._cache, self._last_d, self._lens_d = self._decode(
+                self.params, self._cache, self._pt(), self._last_d,
+                self._lens_d, self._next_rng())
+        else:
+            _, self._cache, self._last_d, self._lens_d = self._decode(
+                self.params, self._cache, self._last_d, self._lens_d,
+                self._next_rng())
 
     def start(self):
         self._thread = threading.Thread(target=self._loop,
@@ -881,13 +1236,98 @@ class DecodeEngine:
     def _admit(self, slot_id: int, req: Request) -> None:
         """Single-request admission (tests/back-compat); batched path
         is _admit_group."""
+        pages = None
+        if self._paged:
+            pages = self._alloc_pages(self._pages_needed(req))
+            if pages is None:
+                raise RuntimeError(
+                    f'page pool exhausted: need '
+                    f'{self._pages_needed(req)} pages, '
+                    f'{self._pool_alloc.free_pages} free')
         self._admit_group(self._bucket(len(req.prompt_ids)),
-                          [(slot_id, req)])
+                          [(slot_id, req, pages)])
+
+    # ----- paged-KV host bookkeeping -----------------------------------------
+    def _pages_needed(self, req: Request) -> int:
+        """Pages this request is charged at admission: its WHOLE
+        lifetime (prompt + full token budget), so mid-flight growth can
+        never fail — the ceiling admission control enforces is pages,
+        not slots."""
+        return -(-(len(req.prompt_ids) + req.max_new_tokens)
+                 // self._page_size)
+
+    def _alloc_pages(self, n: int) -> Optional[List[int]]:
+        """Allocate n pages, LRU-evicting prefix-cache pages if the
+        free list runs short.  None (and no partial allocation) when
+        even eviction cannot cover it — the caller retries once live
+        slots retire."""
+        pages = self._pool_alloc.alloc(n)
+        if pages is None and self._radix is not None:
+            freed = self._radix.evict(n - self._pool_alloc.free_pages)
+            if freed:
+                metrics_lib.inc_counter(
+                    'skytpu_engine_prefix_cache_evicted_pages_total',
+                    float(freed))
+            pages = self._pool_alloc.alloc(n)
+        return pages
+
+    def _try_prefix_match(self, req: Request):
+        """Match one request against the radix cache at its COMMIT
+        point (admission / chunk pick — as late as possible, so a
+        burst's later members hit pages its first member published).
+        A hit refs the matched pages on the request's behalf and counts
+        the hit metrics; the match is capped one token short of the
+        prompt so there is always a suffix to prefill (the first output
+        token is sampled from it).  Misses are counted by the caller
+        when the request actually admits — a request re-examined while
+        it waits for pages must not double-count."""
+        max_pages = (len(req.prompt_ids) - 1) // self._page_size
+        n, pages = self._radix.match(req.prompt_ids, max_pages)
+        if n:
+            metrics_lib.inc_counter(
+                'skytpu_engine_prefix_cache_hits_total')
+            metrics_lib.inc_counter(
+                'skytpu_engine_prefix_cache_tokens_total',
+                float(n * self._page_size))
+        return n, pages
+
+    def _route_queued(self) -> None:
+        """Drain submitted short prompts into the loop's ready queue
+        (prefix classification happens at admission time, against the
+        trie as it stands THEN)."""
+        while True:
+            try:
+                req = self._prefill_q.get_nowait()
+            except queue.Empty:
+                return
+            self._ready_q.append(req)
+
+    def _pt(self):
+        """Device copy of the page tables, refreshed only when host
+        bookkeeping changed (async H2D — never a sync)."""
+        if self._pt_dirty or self._pt_device is None:
+            self._pt_device = jnp.asarray(self._page_tables)
+            self._pt_dirty = False
+        return self._pt_device
+
+    def _pt_row(self, pages: List[int]) -> np.ndarray:
+        row = np.full((self._pages_per_slot,), TRASH_PAGE, np.int32)
+        row[:len(pages)] = pages
+        return row
+
+    def _dispatch_decode(self):
+        if self._paged:
+            return self._decode(self.params, self._cache, self._pt(),
+                                self._last_d, self._lens_d,
+                                self._next_rng())
+        return self._decode(self.params, self._cache, self._last_d,
+                            self._lens_d, self._next_rng())
 
     def _admit_group(self, bucket: int, group) -> None:
-        """Dispatch ONE batched prefill+insert for all (slot, request)
-        pairs of a bucket; does NOT sync — each first token is emitted
-        from row 0 of the next decode call's output.
+        """Dispatch ONE batched prefill+insert for all (slot, request,
+        pages) triples of a bucket (pages is None on the unpaged
+        engine); does NOT sync — each first token is emitted from row 0
+        of the next decode call's output.
 
         The group is padded to a power-of-two row count (few compiled
         shapes: |buckets| x log2(n_slots)); padding replicates row 0,
@@ -899,35 +1339,66 @@ class DecodeEngine:
         lengths = np.zeros((padded_n,), np.int32)
         slots = np.zeros((padded_n,), np.int32)
         valid = np.zeros((padded_n,), np.int32)
-        for j, (slot_id, req) in enumerate(group):
+        pt_rows = (np.full((padded_n, self._pages_per_slot), TRASH_PAGE,
+                           np.int32) if self._paged else None)
+        for j, (slot_id, req, pages) in enumerate(group):
             plen = len(req.prompt_ids)
             tokens[j, :plen] = req.prompt_ids
             lengths[j] = plen
             slots[j] = slot_id
             valid[j] = 1
+            if pages is not None:
+                pt_rows[j, :len(pages)] = pages
         tokens[n:] = tokens[0]
         lengths[n:] = lengths[0]
         slots[n:] = slots[0]
+        if pt_rows is not None:
+            pt_rows[n:] = pt_rows[0]
         prefill = self._prefill_for(bucket, padded_n)
         t0 = time.perf_counter()
-        self._cache, self._last_d, self._lens_d = prefill(
-            self.params, self._cache, self._last_d, self._lens_d,
-            jnp.asarray(tokens), jnp.asarray(lengths), jnp.asarray(slots),
-            jnp.asarray(valid), self._next_rng())
+        if self._paged:
+            self._cache, self._last_d, self._lens_d = prefill(
+                self.params, self._cache, self._last_d, self._lens_d,
+                jnp.asarray(tokens), jnp.asarray(lengths),
+                jnp.asarray(slots), jnp.asarray(pt_rows),
+                jnp.asarray(valid), self._next_rng())
+        else:
+            self._cache, self._last_d, self._lens_d = prefill(
+                self.params, self._cache, self._last_d, self._lens_d,
+                jnp.asarray(tokens), jnp.asarray(lengths),
+                jnp.asarray(slots), jnp.asarray(valid), self._next_rng())
         t1 = time.perf_counter()
-        for slot_id, req in group:
-            self._slots[slot_id] = _Slot(req, len(req.prompt_ids))
+        for j, (slot_id, req, pages) in enumerate(group):
+            self._slots[slot_id] = _Slot(req, len(req.prompt_ids),
+                                         pages=pages)
+            if self._paged:
+                self._page_tables[slot_id] = pt_rows[j]
+                self._pt_dirty = True
+                if self._radix is not None:
+                    # Publish the prompt's full pages immediately:
+                    # concurrent requests sharing the prefix hit from
+                    # here on (the writes they gather are already
+                    # queued ahead of them on device).
+                    n_full = len(req.prompt_ids) // self._page_size
+                    if n_full:
+                        self._radix.insert(
+                            req.prompt_ids[:n_full * self._page_size],
+                            pages[:n_full])
             if req.request_id is not None:
                 # Host-side stamps only (the dispatch is async): the
                 # spans tile [submit, prefill-dispatch end]; the
                 # engine.dispatch span picks up from prefill_end_at.
-                tracing.record_span(req.request_id, 'engine.queue_wait',
-                                    req.submitted_at, t0)
+                # Spill-demoted requests recorded queue_wait on their
+                # original hit path — never twice.
+                if not req.no_prefix:
+                    tracing.record_span(req.request_id,
+                                        'engine.queue_wait',
+                                        req.submitted_at, t0)
                 tracing.record_span(req.request_id, 'engine.prefill',
                                     t0, t1, bucket=bucket, slot=slot_id,
                                     group=len(group))
                 req.prefill_end_at = t1
-        n_tokens = sum(len(r.prompt_ids) for _, r in group)
+        n_tokens = sum(len(r.prompt_ids) for _, r, _pg in group)
         with self._submit_lock:
             self._queued_tokens -= n_tokens
         metrics_lib.inc_counter('skytpu_engine_prefill_tokens_total',
@@ -960,10 +1431,37 @@ class DecodeEngine:
                 decode_s=(round(req.finished_at - req.first_token_at, 6)
                           if req.first_token_at is not None else None))
         req.out.put(None)
+        if slot.pages is not None:
+            self._release_slot_pages(slot)
+            # Point the slot's table at trash so later decode calls
+            # cannot scribble into pages a new owner holds — unless a
+            # handoff successor already owns the row.
+            if self._slots[slot_id] is slot:
+                self._page_tables[slot_id] = TRASH_PAGE
+                self._pt_dirty = True
         # Under handoff a successor may already occupy the index — only
         # clear the mapping when it still points at the finished slot.
         if self._slots[slot_id] is slot:
             self._slots[slot_id] = None
+
+    def _release_slot_pages(self, slot: _Slot) -> None:
+        """Retire-time page bookkeeping: donate the pages covering the
+        finished sequence (prompt + generated tokens whose KV was
+        written — every emitted token except the last fed a later step)
+        to the radix cache, then drop this slot's references.  Shared
+        prefix pages return to their other holders; owned pages either
+        live on in the cache (multi-turn replays of prompt+reply hit
+        them) or free."""
+        req = slot.request
+        if self._radix is not None:
+            usable = len(req.prompt_ids) + req.emitted - 1
+            n_full = min(usable // self._page_size, len(slot.pages))
+            if n_full > 0:
+                seq = req.prompt_ids + slot.toks
+                self._radix.insert(seq[:n_full * self._page_size],
+                                   slot.pages[:n_full])
+        self._pool_alloc.release(slot.pages)
+        slot.pages = None
 
     def _admit_free(self, handoff: Optional[List[int]] = None) -> None:
         """Admit queued requests into free slots (grouped per bucket —
@@ -987,24 +1485,119 @@ class DecodeEngine:
             # slots at the tail only free after the in-flight call.
             free.pop(0)
         by_bucket: Dict[int, list] = {}
-        while free and not self._prefill_q.empty():
-            try:
-                req = self._prefill_q.get_nowait()
-            except queue.Empty:
-                break
-            by_bucket.setdefault(
-                self._bucket(len(req.prompt_ids)), []).append(
-                    (free.pop(0), req))
+        if self._paged:
+            # Prefix-cache routing first, then admission charges PAGES:
+            # a request admits only when its whole lifetime fits the
+            # pool (evicting cached pages as needed).  Head-of-line on
+            # allocation failure — retiring slots free pages in order.
+            self._route_queued()
+            while free and self._ready_q:
+                req = self._ready_q[0]
+                if self._radix is not None and not req.no_prefix:
+                    n, pages = self._try_prefix_match(req)
+                    if n:
+                        # Hit: the suffix prefills through the chunk
+                        # machinery against the gathered prefix — no
+                        # slot consumed here.
+                        self._ready_q.popleft()
+                        self._hit_q.append((req, n, pages))
+                        continue
+                pages = self._alloc_pages(self._pages_needed(req))
+                if pages is None:
+                    break
+                self._ready_q.popleft()
+                if self._radix is not None and not req.no_prefix:
+                    # A spill-demoted request already counted its hit;
+                    # counting a miss too would skew the hit rate.
+                    metrics_lib.inc_counter(
+                        'skytpu_engine_prefix_cache_misses_total')
+                by_bucket.setdefault(
+                    self._bucket(len(req.prompt_ids)), []).append(
+                        (free.pop(0), req, pages))
+        else:
+            while free and not self._prefill_q.empty():
+                try:
+                    req = self._prefill_q.get_nowait()
+                except queue.Empty:
+                    break
+                by_bucket.setdefault(
+                    self._bucket(len(req.prompt_ids)), []).append(
+                        (free.pop(0), req, None))
         for bucket, group in by_bucket.items():
             self._admit_group(bucket, group)
 
     def _final_insert_pending(self) -> bool:
         """True when the active chunked prefill has reached its final
-        chunk and is waiting on a free slot to insert into."""
+        chunk and is waiting on a free slot to insert into (a pending
+        prefix-cache hit counts: its suffix needs a slot just as
+        soon)."""
         cp = self._chunked
-        return (cp is not None and
-                len(cp.request.prompt_ids) - cp.offset
+        if cp is None:
+            return bool(self._hit_q)
+        return (len(cp.request.prompt_ids) - cp.offset
                 <= self.cfg.prefill_buckets[-1])
+
+    def _start_chunked(self) -> bool:
+        """Activate the next request for the chunk machinery: a pending
+        prefix-cache hit first (its matched pages gather into a seeded
+        scratch and the prefill starts PAST the match — the skipped
+        work is the prefix cache's whole point), else the next long
+        prompt (itself prefix-matched when the cache is on)."""
+        matched, pages = 0, []
+        if self._hit_q:
+            req, matched, pages = self._hit_q.popleft()
+        else:
+            try:
+                req = self._long_q.get_nowait()
+            except queue.Empty:
+                return False
+            if self._radix is not None and not req.no_prefix:
+                matched, pages = self._try_prefix_match(req)
+                if not matched:
+                    metrics_lib.inc_counter(
+                        'skytpu_engine_prefix_cache_misses_total')
+        if not matched:
+            self._chunked = _ChunkedPrefill(req, self._new_scratch())
+            return True
+        t0 = time.perf_counter()
+        scratch = self._gather_prefix(self._cache,
+                                      jnp.asarray(self._pt_row(pages)))
+        t1 = time.perf_counter()
+        offset = matched * self._page_size
+        cp = _ChunkedPrefill(req, scratch, offset=offset,
+                             shared_pages=pages)
+        cp.last_chunk_end = t1
+        self._chunked = cp
+        rid = req.request_id
+        if rid is not None:
+            tracing.record_span(rid, 'engine.queue_wait',
+                                req.submitted_at, t0)
+            tracing.record_span(rid, 'engine.prefix_hit', t0, t1,
+                                cached_tokens=offset, pages=matched)
+        with self._submit_lock:
+            self._queued_tokens -= offset
+        return True
+
+    def _spill_stuck_hits(self) -> None:
+        """Release every pinned prefix match (the active seeded prefill
+        and all waiting hits) and requeue the requests for FULL
+        prefill.  Only reachable when a final insert cannot allocate
+        with zero live slots — a pool sized near its floor — so
+        correctness (progress) wins over reuse."""
+        cp = self._chunked
+        if cp is not None and cp.shared_pages:
+            self._pool_alloc.release(cp.shared_pages)
+            # Restart from token zero with a fresh scratch next pick.
+            self._chunked = None
+            cp.request.no_prefix = True
+            self._long_q.put(cp.request)
+            with self._submit_lock:
+                self._queued_tokens += cp.offset
+        while self._hit_q:
+            req, _n, pages = self._hit_q.popleft()
+            self._pool_alloc.release(pages)
+            req.no_prefix = True
+            self._ready_q.appendleft(req)
 
     def _step_chunked(self) -> bool:
         """Dispatch at most ONE chunk of the active long-prompt
@@ -1017,12 +1610,8 @@ class DecodeEngine:
         the scratch cache into a free slot (waiting for one to retire
         if none is free — decode keeps running meanwhile).  Returns
         True if a dispatch was made."""
-        if self._chunked is None:
-            try:
-                req = self._long_q.get_nowait()
-            except queue.Empty:
-                return False
-            self._chunked = _ChunkedPrefill(req, self._new_scratch())
+        if self._chunked is None and not self._start_chunked():
+            return False
         cp = self._chunked
         prompt = cp.request.prompt_ids
         rem = len(prompt) - cp.offset
@@ -1037,7 +1626,11 @@ class DecodeEngine:
                 jnp.asarray(cp.offset, jnp.int32))
             t1 = time.perf_counter()
             if rid is not None:
-                if cp.offset == 0:
+                if cp.offset == 0 and not cp.request.no_prefix:
+                    # (A spill-demoted request recorded its queue_wait
+                    # in the hit path already — the discarded gather's
+                    # span stays as what actually happened, and the
+                    # restart gap reads as unattributed time.)
                     tracing.record_span(rid, 'engine.queue_wait',
                                         cp.request.submitted_at, t0)
                 tracing.record_span(
@@ -1053,18 +1646,45 @@ class DecodeEngine:
                             if self._slots[i] is None), None)
             if slot_id is None:
                 return False             # all slots busy: retry later
+            pages_all, n_shared, row = None, 0, None
+            if self._paged:
+                n_shared = len(cp.shared_pages)
+                owned = self._alloc_pages(
+                    self._pages_needed(cp.request) - n_shared)
+                if owned is None:
+                    if (self._inflight is None and
+                            all(s is None for s in self._slots)):
+                        # Nothing live can ever free a page: the pool
+                        # is pinned by waiting prefix matches (tiny
+                        # kv_pages).  Drop every pinned match and fall
+                        # back to full prefills — slower, never stuck.
+                        self._spill_stuck_hits()
+                    return False         # pool short: retry next iter
+                pages_all = cp.shared_pages + owned
+                row = self._pt_row(pages_all)
             bucket = self._bucket(rem)
             t0 = time.perf_counter()
             buf = np.zeros((1, bucket), np.int32)
             buf[0, :rem] = prompt[cp.offset:]
-            (self._cache, self._last_d,
-             self._lens_d) = self._chunk_insert_for(bucket)(
-                 self.params, self._cache, self._last_d, self._lens_d,
-                 cp.scratch, jnp.asarray(buf),
-                 jnp.asarray(rem, jnp.int32),
-                 jnp.asarray(cp.offset, jnp.int32),
-                 jnp.asarray(len(prompt), jnp.int32),
-                 jnp.asarray(slot_id, jnp.int32), self._next_rng())
+            if self._paged:
+                (self._cache, self._last_d,
+                 self._lens_d) = self._chunk_insert(
+                     self.params, self._cache, self._last_d, self._lens_d,
+                     cp.scratch, jnp.asarray(buf),
+                     jnp.asarray(rem, jnp.int32),
+                     jnp.asarray(cp.offset, jnp.int32),
+                     jnp.asarray(len(prompt), jnp.int32),
+                     jnp.asarray(slot_id, jnp.int32), jnp.asarray(row),
+                     self._next_rng())
+            else:
+                (self._cache, self._last_d,
+                 self._lens_d) = self._chunk_insert_for(bucket)(
+                     self.params, self._cache, self._last_d, self._lens_d,
+                     cp.scratch, jnp.asarray(buf),
+                     jnp.asarray(rem, jnp.int32),
+                     jnp.asarray(cp.offset, jnp.int32),
+                     jnp.asarray(len(prompt), jnp.int32),
+                     jnp.asarray(slot_id, jnp.int32), self._next_rng())
             t1 = time.perf_counter()
             if rid is not None:
                 # queue_wait was recorded by the FIRST chunk, which is
@@ -1079,7 +1699,18 @@ class DecodeEngine:
                     t1, offset=cp.offset, width=bucket, final=True,
                     slot=slot_id)
                 cp.request.prefill_end_at = t1
-            self._slots[slot_id] = _Slot(cp.request, len(prompt))
+            self._slots[slot_id] = _Slot(cp.request, len(prompt),
+                                         pages=pages_all,
+                                         n_shared=n_shared)
+            if self._paged:
+                self._page_tables[slot_id] = row
+                self._pt_dirty = True
+                if self._radix is not None:
+                    n_full = len(prompt) // self._page_size
+                    if n_full:
+                        self._radix.insert(
+                            prompt[:n_full * self._page_size],
+                            pages_all[:n_full])
             self._chunked = None
             done = rem
         with self._submit_lock:
@@ -1093,11 +1724,16 @@ class DecodeEngine:
         """Loop-thread occupancy/queue gauges; skipped when unchanged so
         the idle 1 kHz loop does not hammer the registry lock."""
         sample = (n_active,
-                  self._prefill_q.qsize() + self._long_q.qsize(),
-                  self._queued_tokens)
+                  self._prefill_q.qsize() + self._long_q.qsize() +
+                  len(self._ready_q) + len(self._hit_q),
+                  self._queued_tokens,
+                  self._pool_alloc.free_pages if self._paged else -1)
         if sample == self._last_gauges:
             return
         self._last_gauges = sample
+        if self._paged:
+            metrics_lib.set_gauge('skytpu_engine_kv_free_pages',
+                                  float(sample[3]))
         metrics_lib.set_gauge('skytpu_engine_active_slots',
                               float(n_active))
         metrics_lib.set_gauge('skytpu_engine_batch_occupancy_ratio',
@@ -1124,9 +1760,8 @@ class DecodeEngine:
         if not active:
             self._release_retiring()
             return 0
-        out, self._cache, self._last_d, self._lens_d = self._decode(
-            self.params, self._cache, self._last_d, self._lens_d,
-            self._next_rng())
+        out, self._cache, self._last_d, self._lens_d = \
+            self._dispatch_decode()
         # skytpu: allow-sync(the ONE device->host fetch per step — the engine's contract)
         out = np.asarray(out)            # [T+1, B] — the ONE sync per step
         self._process_rows(out, {i: self._slots[i] for i in active})
@@ -1166,9 +1801,8 @@ class DecodeEngine:
         self._sample_gauges(len(active))
         dispatched = None
         if active:
-            out_d, self._cache, self._last_d, self._lens_d = self._decode(
-                self.params, self._cache, self._last_d, self._lens_d,
-                self._next_rng())
+            out_d, self._cache, self._last_d, self._lens_d = \
+                self._dispatch_decode()
             dispatched = (out_d, {i: self._slots[i] for i in active})
         chunked = self._step_chunked()   # queues behind the decode call
         if self._inflight is not None:
@@ -1239,6 +1873,10 @@ class DecodeEngine:
             for t in range(start, out.shape[0]):
                 tok = int(out[t, i])
                 slot.length += 1
+                if slot.pages is not None and self._radix is not None:
+                    # Retire donates prompt+generated pages to the
+                    # prefix cache; it needs the generated token ids.
+                    slot.toks.append(tok)
                 self._emit(slot.request, tok)
                 emitted += 1
                 if self._finished(slot, tok):
@@ -1283,6 +1921,12 @@ class DecodeEngine:
                         cp, self._chunked = self._chunked, None
                         cp.request.finished_at = time.perf_counter()
                         cp.request.out.put(None)
+                    for req in list(self._ready_q) + \
+                            [h[0] for h in self._hit_q]:
+                        req.finished_at = time.perf_counter()
+                        req.out.put(None)
+                    self._ready_q.clear()
+                    self._hit_q.clear()
                     for pending in (self._prefill_q, self._long_q):
                         while True:
                             try:

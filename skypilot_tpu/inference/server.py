@@ -179,6 +179,33 @@ def main() -> None:
         'service.tensor_parallel, which arrives here as '
         'SKYTPU_SERVE_TENSOR.')
     parser.add_argument(
+        '--kv-page-size', type=int,
+        default=int(os.environ.get('SKYTPU_SERVE_KV_PAGE_SIZE', '0')),
+        help='paged KV cache: page size in tokens (must divide every '
+        'prefill bucket and max_seq_len).  Admission then charges '
+        'pages instead of reserving n_slots x max_seq_len of HBM, and '
+        'shared prompt prefixes are prefilled once (--prefix-cache).  '
+        '0 = the contiguous layout.  Serve specs set it via '
+        'service.kv_page_size, which arrives here as '
+        'SKYTPU_SERVE_KV_PAGE_SIZE.')
+    parser.add_argument(
+        '--kv-pages', type=int,
+        default=int(os.environ.get('SKYTPU_SERVE_KV_PAGES', '0')),
+        help='page-pool size (with --kv-page-size).  0 = full backing '
+        '(n_slots x max_seq_len / page_size, no admission risk); '
+        'smaller values cap KV HBM at pool size and let admission '
+        'control — which charges actual request length — pack more '
+        'slots than full reservation would.')
+    parser.add_argument(
+        '--prefix-cache', type=int, choices=(0, 1),
+        default=int(os.environ.get('SKYTPU_SERVE_PREFIX_CACHE', '1')),
+        help='radix prefix cache over the paged KV pool (needs '
+        '--kv-page-size): requests sharing a page-aligned token '
+        'prefix (system prompts, few-shot templates, multi-turn '
+        'replays) reference the cached pages instead of prefilling '
+        'them.  Serve specs set it via service.prefix_cache '
+        '(SKYTPU_SERVE_PREFIX_CACHE).')
+    parser.add_argument(
         '--checkpoint', default=None,
         help='orbax checkpoint dir (local path or gs://bucket/prefix); '
         'restores trained params instead of random init')
@@ -225,13 +252,19 @@ def main() -> None:
     engine = DecodeEngine(
         model, params,
         EngineConfig(n_slots=args.n_slots, mesh=mesh,
-                     max_prompt_len=args.max_prompt_len or None))
+                     max_prompt_len=args.max_prompt_len or None,
+                     kv_page_size=args.kv_page_size or None,
+                     kv_pages=args.kv_pages or None,
+                     prefix_cache=bool(args.prefix_cache)))
     # Compile every prefill shape before taking traffic — a mid-burst
     # XLA compile would stall the whole decode batch for seconds.
     engine.prewarm()
     engine.start()
     logger.info(f'serving {args.model} on :{args.port} '
                 f'({args.n_slots} slots, tensor={args.tensor}, '
+                f'kv_page_size={args.kv_page_size or "off"}, '
+                f'prefix_cache='
+                f'{bool(args.prefix_cache and args.kv_page_size)}, '
                 f'checkpoint={args.checkpoint or "random-init"})')
     web.run_app(build_app(engine), port=args.port, print=None)
 

@@ -192,7 +192,8 @@ class Attention(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array, positions: jax.Array,
-                 decode: bool = False) -> jax.Array:
+                 decode: bool = False,
+                 page_table: Optional[jax.Array] = None) -> jax.Array:
         cfg = self.cfg
         dense = lambda name, heads, logical: nn.DenseGeneral(  # noqa: E731
             features=(heads, cfg.head_dim), axis=-1, use_bias=False,
@@ -208,7 +209,10 @@ class Attention(nn.Module):
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
 
-        if decode:
+        if decode and page_table is not None:
+            k, v, attn_out = self._paged_attend(q, k, v, positions,
+                                                page_table)
+        elif decode:
             k, v, attn_out = self._decode_attend(q, k, v, positions)
         else:
             attn_out = self._attend(q, k, v)
@@ -302,6 +306,60 @@ class Attention(nn.Module):
             kv_positions=jnp.broadcast_to(k_pos, (b, max_len)))
         return k_all, v_all, out
 
+    def _paged_attend(self, q, k, v, positions, page_table):
+        """Decode against a PAGED cache: the cache variables hold the
+        whole engine's page pool [n_pages, n_kv_heads, page_size, D]
+        and ``page_table`` [B, pages_per_slot] maps each slot's logical
+        page index -> physical page, so a slot's sequence lives in
+        whatever pages the host allocator handed it — shared prefix
+        pages included.  Each step scatter-writes one row into the
+        slot's CURRENT page (always slot-owned: shared pages end at the
+        match boundary and writes only happen past it), then gathers
+        the slot's pages back into position order and attends exactly
+        like the dense path — same shapes, same masks, so greedy
+        outputs are token-identical to the unpaged engine.
+
+        Steady-state decode only (S == 1): prefill and chunked prefill
+        run against dense per-request caches and are PAGED only at
+        insert time (engine-side scatters).  The pool shards over its
+        kv-heads dim under tensor parallelism; page ids index the
+        unsharded dim 0, so gathers and scatters stay local to each
+        chip's head shard.
+        """
+        cfg = self.cfg
+        if not self.has_variable('cache', 'k') or q.shape[2] != 1:
+            raise ValueError(
+                'paged attention is the steady-state decode path: the '
+                'engine supplies the page pool as the cache and S == 1')
+        ck = self.variable('cache', 'k', jnp.zeros, (), cfg.dtype)
+        cv = self.variable('cache', 'v', jnp.zeros, (), cfg.dtype)
+        ps = ck.value.shape[2]
+        b = q.shape[0]
+        n_logical = page_table.shape[1] * ps
+        pos = positions[:, 0]                                # [B]
+        page_ids = jnp.take_along_axis(page_table, (pos // ps)[:, None],
+                                       axis=1)[:, 0]         # [B]
+        off = pos % ps
+        # Write this step's K/V at (page, in-page offset).  Distinct
+        # live slots never share their write page (allocator invariant);
+        # inactive slots all point at the trash page — duplicate-index
+        # garbage the masks below keep unread.
+        ck.value = ck.value.at[page_ids, :, off, :].set(k[:, :, 0, :])
+        cv.value = cv.value.at[page_ids, :, off, :].set(v[:, :, 0, :])
+
+        def _gather(pool):
+            g = pool[page_table]                 # [B, P, H, ps, D]
+            g = g.transpose(0, 2, 1, 3, 4)       # [B, H, P, ps, D]
+            return g.reshape(b, pool.shape[1], n_logical, pool.shape[3])
+
+        k_all, v_all = _gather(ck.value), _gather(cv.value)
+        k_pos = jnp.arange(n_logical)[None, :]
+        out = attn_lib.mha_reference(
+            q, k_all, v_all, causal=True,
+            segment_positions=positions,
+            kv_positions=jnp.broadcast_to(k_pos, (b, n_logical)))
+        return k_all, v_all, out
+
 
 class MLP(nn.Module):
     cfg: LlamaConfig
@@ -326,13 +384,14 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array, positions: jax.Array,
-                 decode: bool = False) -> jax.Array:
+                 decode: bool = False,
+                 page_table: Optional[jax.Array] = None) -> jax.Array:
         cfg = self.cfg
         cp = cfg.attention_impl == 'ring'
         x = _constrain_activations(x, self.mesh, cp)
         x = x + Attention(cfg, self.mesh, name='attn')(
             RMSNorm(cfg.norm_eps, cfg.dtype, cfg.param_dtype,
-                    name='attn_norm')(x), positions, decode)
+                    name='attn_norm')(x), positions, decode, page_table)
         if cfg.n_experts > 0:
             from skypilot_tpu.models.moe import MoEMLP
             mlp = MoEMLP(dim=cfg.dim, ffn_dim=cfg.ffn_dim,
@@ -355,7 +414,8 @@ class Llama(nn.Module):
     @nn.compact
     def __call__(self, tokens: jax.Array,
                  positions: Optional[jax.Array] = None,
-                 decode: bool = False) -> jax.Array:
+                 decode: bool = False,
+                 page_table: Optional[jax.Array] = None) -> jax.Array:
         cfg = self.cfg
         if positions is None:
             positions = jnp.broadcast_to(
@@ -379,8 +439,14 @@ class Llama(nn.Module):
                 Block, static_argnums=(3,),  # (self, x, positions, decode)
                 policy=policy)
         for i in range(cfg.n_layers):
-            x = block(cfg, self.mesh, name=f'layer_{i}')(
-                x, positions, decode)
+            if page_table is None:
+                # Keep the historical 3-arg call (the remat wrapper's
+                # static_argnums indexing depends on it).
+                x = block(cfg, self.mesh, name=f'layer_{i}')(
+                    x, positions, decode)
+            else:
+                x = block(cfg, self.mesh, name=f'layer_{i}')(
+                    x, positions, decode, page_table)
         x = RMSNorm(cfg.norm_eps, cfg.dtype, cfg.param_dtype,
                     name='final_norm')(x)
         if cfg.tie_embeddings:

@@ -42,6 +42,9 @@ ENV_REPLICA_ID = 'SKYTPU_SERVE_REPLICA_ID'
 ENV_SERVICE_NAME = 'SKYTPU_SERVE_SERVICE_NAME'
 ENV_REPLICA_TENSOR = 'SKYTPU_SERVE_TENSOR'
 ENV_REPLICA_MAX_PROMPT = 'SKYTPU_SERVE_MAX_PROMPT_LEN'
+ENV_REPLICA_KV_PAGE = 'SKYTPU_SERVE_KV_PAGE_SIZE'
+ENV_REPLICA_KV_PAGES = 'SKYTPU_SERVE_KV_PAGES'
+ENV_REPLICA_PREFIX_CACHE = 'SKYTPU_SERVE_PREFIX_CACHE'
 
 
 class ReplicaManager:
@@ -135,6 +138,17 @@ class ReplicaManager:
             # --max-prompt-len default: admission cap for long prompts
             # (chunked prefill serves anything up to the model limit).
             envs[ENV_REPLICA_MAX_PROMPT] = str(self.spec.max_prompt_len)
+        if self.spec.kv_page_size is not None:
+            # --kv-page-size default: paged KV cache + (by default)
+            # the radix prefix cache on each replica's engine.
+            envs[ENV_REPLICA_KV_PAGE] = str(self.spec.kv_page_size)
+        if self.spec.kv_pages is not None:
+            # --kv-pages default: pool size — THIS is where the
+            # HBM-per-slot reservation actually shrinks.
+            envs[ENV_REPLICA_KV_PAGES] = str(self.spec.kv_pages)
+        if self.spec.prefix_cache is not None:
+            envs[ENV_REPLICA_PREFIX_CACHE] = \
+                str(int(self.spec.prefix_cache))
         task.update_envs(envs)
         res = task.any_resources
         overrides = {}

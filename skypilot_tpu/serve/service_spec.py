@@ -46,6 +46,25 @@ class ServiceSpec:
     # SKYTPU_SERVE_MAX_PROMPT_LEN (the inference server's
     # --max-prompt-len default).
     max_prompt_len: Optional[int] = None
+    # Paged KV cache page size (tokens) for the replica's engine: break
+    # the slot-contiguous KV cache into pages so admission charges
+    # pages instead of reserving max_seq_len per slot, and prefix_cache
+    # below can share pages across requests.  Must divide the engine's
+    # prefill buckets and max_seq_len.  None = contiguous layout.
+    # Reaches the workload as SKYTPU_SERVE_KV_PAGE_SIZE.
+    kv_page_size: Optional[int] = None
+    # Page-pool size in pages (needs kv_page_size).  None = full
+    # backing (n_slots * max_seq_len / kv_page_size + 1) — paging with
+    # zero admission risk but no HBM saving; sizing it to the traffic
+    # actually served is where KV HBM per slot drops.  Reaches the
+    # workload as SKYTPU_SERVE_KV_PAGES.
+    kv_pages: Optional[int] = None
+    # Radix prefix cache over the paged KV pool (needs kv_page_size):
+    # shared prompt prefixes are prefilled once per replica and
+    # referenced by every matching request.  None = engine default
+    # (on when paging is on).  Reaches the workload as
+    # SKYTPU_SERVE_PREFIX_CACHE.
+    prefix_cache: Optional[bool] = None
     # Latency SLO targets (milliseconds): with either set, the
     # controller runs the SLOAutoscaler — scale up on p95 TTFT/TPOT
     # violation measured from the LB's federated histograms, scale down
@@ -84,6 +103,21 @@ class ServiceSpec:
         max_prompt_raw = config.get('max_prompt_len')
         max_prompt_len = (int(max_prompt_raw)
                           if max_prompt_raw is not None else None)
+        page_raw = config.get('kv_page_size')
+        kv_page_size = int(page_raw) if page_raw is not None else None
+        pages_raw = config.get('kv_pages')
+        kv_pages = int(pages_raw) if pages_raw is not None else None
+        prefix_raw = config.get('prefix_cache')
+        prefix_cache = (bool(prefix_raw)
+                        if prefix_raw is not None else None)
+        if prefix_cache and kv_page_size is None:
+            raise exceptions.InvalidTaskError(
+                'service.prefix_cache requires service.kv_page_size '
+                '(the cache shares KV at page granularity)')
+        if kv_pages is not None and kv_page_size is None:
+            raise exceptions.InvalidTaskError(
+                'service.kv_pages requires service.kv_page_size '
+                '(it sizes the paged pool)')
         shed_raw = config.get('max_queue_tokens_per_replica')
         max_queue_tokens = int(shed_raw) if shed_raw is not None else None
         if max_queue_tokens is not None and max_queue_tokens <= 0:
@@ -99,6 +133,9 @@ class ServiceSpec:
                            'load_balancing_policy', 'least_load'),
                        tensor_parallel=tensor_parallel,
                        max_prompt_len=max_prompt_len,
+                       kv_page_size=kv_page_size,
+                       kv_pages=kv_pages,
+                       prefix_cache=prefix_cache,
                        max_queue_tokens_per_replica=max_queue_tokens)
         min_r = int(policy.get('min_replicas', 1))
         max_r = policy.get('max_replicas')
@@ -152,6 +189,9 @@ class ServiceSpec:
                 policy.get('base_ondemand_fallback_replicas', 0)),
             tensor_parallel=tensor_parallel,
             max_prompt_len=max_prompt_len,
+            kv_page_size=kv_page_size,
+            kv_pages=kv_pages,
+            prefix_cache=prefix_cache,
             target_ttft_ms=(float(target_ttft)
                             if target_ttft is not None else None),
             target_tpot_ms=(float(target_tpot)
@@ -197,6 +237,12 @@ class ServiceSpec:
             out['tensor_parallel'] = self.tensor_parallel
         if self.max_prompt_len is not None:
             out['max_prompt_len'] = self.max_prompt_len
+        if self.kv_page_size is not None:
+            out['kv_page_size'] = self.kv_page_size
+        if self.kv_pages is not None:
+            out['kv_pages'] = self.kv_pages
+        if self.prefix_cache is not None:
+            out['prefix_cache'] = self.prefix_cache
         if self.max_queue_tokens_per_replica is not None:
             out['max_queue_tokens_per_replica'] = \
                 self.max_queue_tokens_per_replica

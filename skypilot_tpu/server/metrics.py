@@ -67,6 +67,24 @@ _HELP = {
         'prompt) — the long-prompt backlog per replica',
     'skytpu_engine_decode_tokens_total':
         'Tokens emitted by the decode loop',
+    'skytpu_engine_prefix_cache_hits_total':
+        'Requests whose prompt matched cached KV pages (the matched '
+        'prefill work is skipped — the pages are referenced, not '
+        'recomputed)',
+    'skytpu_engine_prefix_cache_misses_total':
+        'Requests whose prompt matched no cached KV pages (full '
+        'prefill)',
+    'skytpu_engine_prefix_cache_tokens_total':
+        'Prompt tokens served from the prefix cache instead of being '
+        'prefilled (page-aligned match length, summed over hits)',
+    'skytpu_engine_prefix_cache_evicted_pages_total':
+        'KV pages LRU-evicted from the prefix cache to satisfy an '
+        'admission (cached-only pages; pages referenced by live slots '
+        'are never evicted)',
+    'skytpu_engine_kv_free_pages':
+        'Free pages in the paged KV pool — admission charges pages '
+        '(ceil((prompt+max_new)/page_size)), so this gauge is the '
+        'engine\'s real admission headroom',
     'skytpu_engine_requests_total':
         'Requests admitted to the engine queue',
     'skytpu_engine_batch_occupancy_ratio':

@@ -91,6 +91,11 @@ SPAN_HELP = {
     'engine.prefill_chunk':
         'One chunked-prefill dispatch of a long prompt, interleaved '
         'with decode; spans tile from the previous chunk dispatch',
+    'engine.prefix_hit':
+        'Prefix-cache hit: the matched KV pages gather into the '
+        'scratch cache instead of being prefilled — cached_tokens '
+        'attrs show the prefill work skipped; prefill resumes past '
+        'the match',
     'engine.dispatch':
         'End of the last prefill dispatch to the host observing the '
         'first token (the decode call the token rode)',
@@ -256,8 +261,14 @@ def decompose(events: List[dict]) -> dict:
 
     queue = sum(durs('engine.queue_wait'))
     chunks = durs('engine.prefill_chunk')
-    prefill = sum(durs('engine.prefill')) + sum(chunks)
+    # A prefix-cache hit's page gather replaces the prefill work it
+    # skipped: its span occupies the same slot in the tiling.
+    hits = durs('engine.prefix_hit')
+    prefill = sum(durs('engine.prefill')) + sum(chunks) + sum(hits)
     dispatch = sum(durs('engine.dispatch'))
+    cached_tokens = sum(
+        e['attrs'].get('cached_tokens') or 0 for e in events
+        if e['name'] == 'engine.prefix_hit')
     first = next((e for e in events if e['name'] == 'engine.first_token'),
                  None)
     ttft_ms = None
@@ -283,6 +294,7 @@ def decompose(events: List[dict]) -> dict:
         'queue_wait_ms': round(queue, 4),
         'prefill_ms': round(prefill, 4),
         'prefill_chunks': len(chunks),
+        'prefix_cached_tokens': cached_tokens,
         'dispatch_ms': round(dispatch, 4),
         'decomposed_ttft_ms': decomposed,
         'unattributed_ms': (round(ttft_ms - decomposed, 4)

@@ -104,6 +104,15 @@ _SERVICE_SCHEMA: Dict[str, Any] = {
         # workload as SKYTPU_SERVE_MAX_PROMPT_LEN; omitted = the model
         # limit — chunked prefill serves prompts up to max_seq_len - 1).
         'max_prompt_len': {'type': 'integer', 'minimum': 1},
+        # Paged KV cache page size in tokens (plumbed to the workload
+        # as SKYTPU_SERVE_KV_PAGE_SIZE; omitted = contiguous layout).
+        'kv_page_size': {'type': 'integer', 'minimum': 1},
+        # Page-pool size in pages (requires kv_page_size; plumbed as
+        # SKYTPU_SERVE_KV_PAGES; omitted = full backing).
+        'kv_pages': {'type': 'integer', 'minimum': 2},
+        # Radix prefix cache over the paged pool (requires
+        # kv_page_size; plumbed as SKYTPU_SERVE_PREFIX_CACHE).
+        'prefix_cache': {'type': 'boolean'},
         # Queue-aware load shedding at the LB: when every ready
         # replica's engine backlog (queued prefill tokens, from the
         # federated gauges / replica response headers) is at or above

@@ -1,0 +1,14 @@
+"""Known-bad: paged-KV / prefix-cache observability registered OUTSIDE
+the central registries — an unregistered metric family and span name
+(the metric-naming rule must catch both halves)."""
+from skypilot_tpu.server import metrics as metrics_lib
+from skypilot_tpu.server import tracing
+
+
+def report(rid, free_pages, t0, t1):
+    metrics_lib.set_gauge('skytpu_engine_kv_rogue_pages',
+                          free_pages)                   # BAD: no _HELP
+    metrics_lib.inc_counter(
+        'skytpu_engine_prefix_cache_rogue_total')       # BAD: no _HELP
+    tracing.record_span(rid, 'engine.prefix_rogue',
+                        t0, t1)                         # BAD: no SPAN_HELP

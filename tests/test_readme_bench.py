@@ -67,6 +67,13 @@ def test_readme_perf_claims_track_latest_bench():
     if saturated and saturated.get('ttft_saturated_ms') is not None:
         claims['saturated TTFT'] = (
             f"saturated TTFT {saturated['ttft_saturated_ms']:.1f} ms")
+    # Prefix-cache sweep (bench_prefix_cache), same contract: the
+    # README's measured prefix-hit TTFT pins once an artifact carries
+    # the scenario.
+    prefix = detail['serve'].get('prefix_cache')
+    if prefix and prefix.get('ttft_prefix_hit_ms') is not None:
+        claims['prefix-hit TTFT'] = (
+            f"prefix-hit TTFT {prefix['ttft_prefix_hit_ms']:.1f} ms")
     # SLO-vs-QPS autoscaling ramp (bench_slo_ramp), same contract.
     slo_ramp = detail['serve'].get('slo_ramp')
     if slo_ramp and slo_ramp.get('p95_tpot_ms_slo') is not None:
@@ -126,6 +133,42 @@ def test_readme_tracing_overhead_claim_pinned():
     assert all(v == want for v in found), (
         f'README recorder-overhead claim {found} drifted from {path}: '
         f'expected {want}')
+
+
+def test_readme_makes_no_unmeasured_prefix_cache_claim():
+    """A numeric prefix-hit TTFT claim in the README must come from
+    the latest bench artifact, not be invented ahead of it — and once
+    an artifact carries the sweep, the measured improvement must be
+    MONOTONE with hit rate (the acceptance criterion, mechanically
+    held)."""
+    path, parsed = _latest_bench()
+    prefix = (parsed['detail'].get('serve') or {}).get('prefix_cache')
+    with open(os.path.join(_ROOT, 'README.md'), encoding='utf-8') as f:
+        readme = ' '.join(f.read().split())
+    found = re.findall(r'prefix-hit TTFT ([0-9.]+) ms', readme)
+    if not prefix or prefix.get('ttft_prefix_hit_ms') is None:
+        assert not found, (
+            f'README claims a prefix-hit TTFT ({found}) but the latest '
+            f'bench artifact {path} has no prefix_cache scenario')
+        return
+    want = f"{prefix['ttft_prefix_hit_ms']:.1f}"
+    assert all(v == want for v in found), (
+        f'README prefix-hit TTFT claim {found} drifted from {path}: '
+        f'expected {want}')
+    sweep = prefix.get('sweep') or []
+    if len(sweep) >= 2:
+        ttfts = [p['ttft_median_ms'] for p in sweep]
+        toks = [p['out_tok_per_s'] for p in sweep]
+        assert ttfts == sorted(ttfts, reverse=True), (
+            f'{path}: TTFT must improve monotonically with prefix hit '
+            f'rate, got {ttfts}')
+        assert toks == sorted(toks), (
+            f'{path}: out-tok/s must improve monotonically with prefix '
+            f'hit rate, got {toks}')
+        assert (sweep[-1]['hbm_bytes_per_slot'] <
+                sweep[-1]['hbm_bytes_per_slot_contiguous']), (
+            f'{path}: paged HBM per slot must undercut the contiguous '
+            f'reservation')
 
 
 def test_readme_makes_no_unmeasured_slo_ramp_claim():

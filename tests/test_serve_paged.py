@@ -1,0 +1,560 @@
+"""Paged KV cache + radix prefix caching (engine cross-request reuse).
+
+Parity contract: the paged engine — page pool, per-slot page tables,
+prefix-cache hits included — must produce greedy output token-identical
+to the unpaged slot-contiguous engine, single-device and under the
+virtual tensor=2 mesh.  Float32 compute for the cross-program
+comparisons, per the test_serve_sharded.py precedent (bf16's one-ULP
+fusion-order noise flips argmax on tiny random weights).
+
+Invariant contract (the soak): every page's refcount equals its live
+holders, no page is referenced by two live slots unless it is a shared
+prefix page, and freed-page count is conserved through admit/finish/
+evict churn.
+"""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.inference.engine import DecodeEngine, EngineConfig
+from skypilot_tpu.inference.paging import TRASH_PAGE, PagePool, RadixCache
+from skypilot_tpu.models.llama import LLAMA_CONFIGS, Llama, init_params
+from skypilot_tpu.parallel.mesh import build_serve_mesh
+
+CFG = dataclasses.replace(LLAMA_CONFIGS['tiny'], dtype=jnp.float32)
+_PROMPT_RNG = np.random.default_rng(11)
+PS = 8     # page size: divides buckets (8, 16) and max_seq_len (128)
+
+
+@pytest.fixture(scope='module')
+def params():
+    return init_params(Llama(CFG), jax.random.PRNGKey(0))['params']
+
+
+def make_engine(params, tensor=1, paged=True, **overrides):
+    mesh = None
+    if tensor > 1:
+        mesh = build_serve_mesh(tensor, n_heads=CFG.n_heads,
+                                n_kv_heads=CFG.n_kv_heads)
+    kw = dict(n_slots=2, prefill_buckets=(8, 16), steps_per_call=3)
+    if paged:
+        kw.update(kv_page_size=PS)
+    kw.update(overrides)
+    return DecodeEngine(Llama(CFG, mesh), params,
+                        EngineConfig(mesh=mesh, **kw))
+
+
+def run_to_completion(engine, reqs, max_steps=3000, step='step'):
+    fn = getattr(engine, step)
+    for _ in range(max_steps):
+        fn()
+        if all(r.finished_at is not None for r in reqs):
+            return
+    raise AssertionError('requests did not finish')
+
+
+def prompt_of(n):
+    return _PROMPT_RNG.integers(1, CFG.vocab_size, n).tolist()
+
+
+def unpaged_reference(params, prompt, n_new):
+    engine = make_engine(params, paged=False)
+    req = engine.submit(prompt, n_new)
+    run_to_completion(engine, [req])
+    return req.tokens()
+
+
+# ----- allocator / radix unit tests ------------------------------------------
+def test_page_pool_alloc_release_conserved():
+    pool = PagePool(10, 4)
+    assert pool.free_pages == 9          # page 0 is trash
+    a = pool.alloc(4)
+    b = pool.alloc(5)
+    assert a is not None and b is not None
+    assert pool.alloc(1) is None         # exhausted: all-or-nothing
+    pool.check_conserved()
+    pool.ref(a)
+    assert pool.release(a) == 0          # still held once
+    assert pool.release(a) == 4
+    assert pool.release(b) == 5
+    assert pool.free_pages == 9
+    pool.check_conserved()
+
+
+def test_radix_match_insert_evict_lru():
+    pool = PagePool(12, 2)
+    cache = RadixCache(pool)
+    toks_a = [1, 2, 3, 4, 5, 6]          # 3 full pages of 2
+    pages_a = pool.alloc(3)
+    assert cache.insert(toks_a, pages_a) == 3
+    # Exact-prefix match, capped, refs taken for the caller.
+    n, pages = cache.match([1, 2, 3, 4, 9, 9], max_pages=3)
+    assert n == 2 and pages == pages_a[:2]
+    assert pool.refcount(pages_a[0]) == 3   # owner + trie + match
+    pool.release(pages)
+    # Diverging second sequence shares the first page only.
+    toks_b = [1, 2, 7, 8]
+    pages_b = pool.alloc(2)
+    assert cache.insert(toks_b, pages_b) == 1   # page 0 already cached
+    pool.release(pages_a)                # original owner retires
+    pool.release(pages_b)
+    pool.check_conserved()
+    # pages_b[0] was NOT adopted (duplicate of pages_a[0]) and freed.
+    assert pool.refcount(pages_b[0]) == 0
+    # LRU eviction: only leaves evict, least-recently-hit first; the
+    # shared root page evicts last (it becomes a leaf only once its
+    # children are gone).
+    assert cache.evict(100) == 4
+    assert cache.nodes == 0
+    pool.check_conserved()
+    assert pool.free_pages == 11
+
+
+def test_radix_never_evicts_live_pages():
+    pool = PagePool(6, 2)
+    cache = RadixCache(pool)
+    pages = pool.alloc(2)
+    cache.insert([1, 2, 3, 4], pages)
+    # A live holder (refcount > 1) pins the page against eviction.
+    assert cache.evict(10) == 0
+    pool.release(pages)
+    assert cache.evict(10) == 2
+
+
+# ----- config validation -----------------------------------------------------
+def test_engine_config_rejects_bad_paging(params):
+    model = Llama(CFG)
+    with pytest.raises(ValueError, match='n_slots'):
+        DecodeEngine(model, params, EngineConfig(n_slots=0))
+    with pytest.raises(ValueError, match='n_slots'):
+        DecodeEngine(model, params, EngineConfig(n_slots=-2))
+    # Page size must divide every bucket: the offending bucket values
+    # appear in the error.
+    with pytest.raises(ValueError) as e:
+        DecodeEngine(model, params,
+                     EngineConfig(prefill_buckets=(8, 12),
+                                  kv_page_size=8))
+        pytest.fail('unreachable')
+    assert '12' in str(e.value) and 'kv_page_size=8' in str(e.value)
+    # Divides the buckets but not max_seq_len (128): max_seq_len named.
+    with pytest.raises(ValueError) as e:
+        DecodeEngine(model, params,
+                     EngineConfig(prefill_buckets=(12, 24),
+                                  kv_page_size=12))
+    assert '128' in str(e.value)
+    with pytest.raises(ValueError, match='kv_page_size'):
+        DecodeEngine(model, params, EngineConfig(kv_page_size=-4))
+    # Pool floor: one max-length request + the trash page.
+    with pytest.raises(ValueError, match='kv_pages'):
+        DecodeEngine(model, params,
+                     EngineConfig(kv_page_size=8, kv_pages=16))
+    DecodeEngine(model, params,
+                 EngineConfig(kv_page_size=8, kv_pages=17))  # floor: ok
+
+
+# ----- parity ----------------------------------------------------------------
+def test_paged_matches_unpaged_single_device(params):
+    prompts = [prompt_of(5), prompt_of(14), prompt_of(40)]  # incl chunked
+    wants = [unpaged_reference(params, p, 6) for p in prompts]
+    engine = make_engine(params)
+    reqs = [engine.submit(p, 6) for p in prompts]
+    run_to_completion(engine, reqs)
+    assert [r.tokens() for r in reqs] == wants
+
+
+def test_paged_matches_unpaged_tensor2(params):
+    prompts = [prompt_of(5), prompt_of(30)]
+    wants = [unpaged_reference(params, p, 6) for p in prompts]
+    engine = make_engine(params, tensor=2)
+    engine.prewarm()
+    reqs = [engine.submit(p, 6) for p in prompts]
+    run_to_completion(engine, reqs, step='step_pipelined')
+    engine.drain()
+    assert [r.tokens() for r in reqs] == wants
+
+
+def test_paged_pipelined_matches_step(params):
+    """Pipelined and synchronous scheduling emit identical tokens with
+    paging + prefix cache on (two passes over the same traffic so the
+    second pass actually hits)."""
+    shared = prompt_of(12)
+
+    def run(step_attr):
+        engine = make_engine(params)
+        outs = []
+        for round_i in range(2):
+            reqs = [engine.submit(shared + [round_i + 1, j], 6)
+                    for j in range(3)]
+            run_to_completion(engine, reqs, step=step_attr)
+            if step_attr == 'step_pipelined':
+                engine.drain()
+            outs.append([r.tokens() for r in reqs])
+        return outs
+
+    assert run('step_pipelined') == run('step')
+
+
+def test_prefix_hit_token_identical_and_counted(params):
+    from skypilot_tpu.server import metrics
+    metrics.reset_for_tests()
+    try:
+        engine = make_engine(params)
+        shared = prompt_of(20)           # 2 full pages
+        pa, pb = shared + prompt_of(3), shared + prompt_of(5)
+        want_a = unpaged_reference(params, pa, 6)
+        want_b = unpaged_reference(params, pb, 6)
+        ra = engine.submit(pa, 6)
+        run_to_completion(engine, [ra])
+        rb = engine.submit(pb, 6)
+        run_to_completion(engine, [rb])
+        assert ra.tokens() == want_a
+        assert rb.tokens() == want_b     # hit path: token-identical
+        text = metrics.render()
+        assert 'skytpu_engine_prefix_cache_hits_total 1.0' in text
+        assert 'skytpu_engine_prefix_cache_misses_total 1.0' in text
+        # 2 full pages x 8 tokens of prefill skipped.
+        assert 'skytpu_engine_prefix_cache_tokens_total 16.0' in text
+        assert 'skytpu_engine_kv_free_pages' in text
+    finally:
+        metrics.reset_for_tests()
+
+
+def test_prefix_hit_records_span_and_decomposes(params):
+    from skypilot_tpu.server import tracing
+    tracing.clear_for_tests()
+    engine = make_engine(params)
+    shared = prompt_of(20)
+    r1 = engine.submit(shared + [7], 4, request_id='paged-miss')
+    run_to_completion(engine, [r1])
+    r2 = engine.submit(shared + [9, 9], 4, request_id='paged-hit')
+    run_to_completion(engine, [r2])
+    events = tracing.events_for('paged-hit')
+    names = [e['name'] for e in events]
+    assert 'engine.prefix_hit' in names
+    hit = next(e for e in events if e['name'] == 'engine.prefix_hit')
+    assert hit['attrs']['cached_tokens'] == 16
+    # The hit span joins the TTFT tiling: queue + prefix_hit + chunks
+    # + dispatch sums to the measured TTFT.
+    s = tracing.decompose(events)
+    assert s['prefix_cached_tokens'] == 16
+    assert s['ttft_ms'] is not None
+    assert abs(s['unattributed_ms']) <= max(0.02 * s['ttft_ms'], 5.0)
+
+
+def test_prefix_cache_off_no_hits(params):
+    from skypilot_tpu.server import metrics
+    metrics.reset_for_tests()
+    try:
+        engine = make_engine(params, prefix_cache=False)
+        shared = prompt_of(20)
+        want = unpaged_reference(params, shared + [9], 5)
+        r1 = engine.submit(shared + [7], 5)
+        run_to_completion(engine, [r1])
+        r2 = engine.submit(shared + [9], 5)
+        run_to_completion(engine, [r2])
+        assert r2.tokens() == want
+        assert 'prefix_cache_hits_total' not in metrics.render()
+    finally:
+        metrics.reset_for_tests()
+
+
+def test_paged_slot_reuse_no_kv_leak(params):
+    """A request admitted into pages a previous request used must
+    generate exactly what it would in a fresh engine."""
+    engine = make_engine(params, n_slots=1, prefix_cache=False)
+    first = engine.submit([4] * 8, 5)
+    run_to_completion(engine, [first])
+    prompt = prompt_of(7)
+    want = unpaged_reference(params, prompt, 5)
+    second = engine.submit(prompt, 5)
+    run_to_completion(engine, [second])
+    assert second.tokens() == want
+
+
+def test_multi_turn_replay_hits_generated_pages(params):
+    """Retire donates prompt+generated pages: a second turn replaying
+    turn 1 (prompt + reply) as its prefix hits beyond the original
+    prompt."""
+    from skypilot_tpu.server import metrics
+    metrics.reset_for_tests()
+    try:
+        engine = make_engine(params)
+        turn1 = prompt_of(16)            # page-aligned prompt
+        r1 = engine.submit(turn1, 9)     # 16 + 9 -> 3 full pages valid
+        run_to_completion(engine, [r1])
+        reply = r1.tokens()
+        turn2 = turn1 + reply + prompt_of(4)
+        want = unpaged_reference(params, turn2, 5)
+        r2 = engine.submit(turn2, 5)
+        run_to_completion(engine, [r2])
+        assert r2.tokens() == want
+        text = metrics.render()
+        assert 'skytpu_engine_prefix_cache_hits_total 1.0' in text
+        # The hit covers 3 pages (24 tokens): past the 16-token prompt,
+        # into the generated region.
+        assert 'skytpu_engine_prefix_cache_tokens_total 24.0' in text
+    finally:
+        metrics.reset_for_tests()
+
+
+# ----- zero recompiles -------------------------------------------------------
+def test_paged_zero_recompiles_mixed_traffic(params):
+    """After one warmup pass over every shape — fused buckets, chunked
+    long prompts, prefix hits — mixed traffic must never add a
+    compiled-call cache entry, single-device and tensor=2."""
+    for tensor in (1, 2):
+        engine = make_engine(params, tensor=tensor)
+        if tensor > 1:
+            engine.prewarm()
+        shared = prompt_of(12)
+        warm = [engine.submit(prompt_of(40), 4),    # chunks + insert
+                engine.submit(prompt_of(5), 4),     # fused bucket 8
+                engine.submit(prompt_of(12), 4),    # fused bucket 16
+                engine.submit(shared + [1], 4)]     # publishes prefix
+        run_to_completion(engine, warm, step='step_pipelined')
+        engine.drain()
+        hit = engine.submit(shared + [2, 3], 4)     # gather path
+        run_to_completion(engine, [hit], step='step_pipelined')
+        engine.drain()
+        fns = [engine._decode, engine._prefill_insert,
+               engine._prefill_chunk, engine._chunk_insert,
+               engine._gather_prefix, engine._scratch_fn]
+        sizes = [f._cache_size() for f in fns]
+        traffic = [engine.submit(prompt_of(55), 5),
+                   engine.submit(shared + [9], 5),  # hit again
+                   engine.submit(prompt_of(7), 5),
+                   engine.submit(prompt_of(16), 5)]
+        run_to_completion(engine, traffic, step='step_pipelined')
+        engine.drain()
+        assert [f._cache_size() for f in fns] == sizes, f'tensor={tensor}'
+
+
+# ----- one sync per step -----------------------------------------------------
+def test_paged_one_sync_per_step(params, monkeypatch):
+    """Paging adds ZERO device->host syncs: page tables ship host->
+    device async and all bookkeeping is host state, so np.asarray (the
+    engine's single per-step fetch) still fires exactly once per
+    active step — prefix hits, gathers and paged inserts included."""
+    import numpy as real_np
+    from skypilot_tpu.inference import engine as engine_mod
+
+    class _Counting:
+        def __init__(self, real):
+            self._real = real
+            self.asarray_calls = 0
+
+        def __getattr__(self, name):
+            return getattr(self._real, name)
+
+        def asarray(self, *args, **kwargs):
+            self.asarray_calls += 1
+            return self._real.asarray(*args, **kwargs)
+
+    counting = _Counting(real_np)
+    monkeypatch.setattr(engine_mod, 'np', counting)
+    engine = make_engine(params)
+    shared = prompt_of(20)
+    active_steps = 0
+
+    def drive(req):
+        nonlocal active_steps
+        while req.finished_at is None:
+            if engine.step() > 0:
+                active_steps += 1
+
+    r1 = engine.submit(shared + [5], 4)
+    drive(r1)
+    r2 = engine.submit(shared + [6, 7], 4)   # prefix hit
+    drive(r2)
+    assert r1.tokens() and r2.tokens()
+    assert counting.asarray_calls == active_steps
+
+
+# ----- eviction / refcount correctness ---------------------------------------
+def _assert_page_invariants(engine):
+    """No page referenced by two live non-sharing slots; refcounts
+    consistent; freed-page count conserved."""
+    engine._pool_alloc.check_conserved()
+    owned_by = {}
+    for i, slot in enumerate(engine._slots):
+        if slot is None or slot.pages is None:
+            continue
+        for j, p in enumerate(slot.pages):
+            if j < slot.n_shared:
+                continue                 # shared prefix pages may repeat
+            assert p not in owned_by, (
+                f'page {p} owned by live slots {owned_by[p]} and {i}')
+            owned_by[p] = i
+
+
+def test_paged_invariants_through_churn(params):
+    """Deterministic churn (mixed admissions, retires, hits) holds the
+    allocator invariants at every synchronous step."""
+    engine = make_engine(params, n_slots=2, kv_pages=24)
+    shared = prompt_of(12)
+    reqs = []
+    for i in range(8):
+        reqs.append(engine.submit(shared + [i + 1], 4))
+        reqs.append(engine.submit(prompt_of(5 + i), 4))
+        for _ in range(4):
+            engine.step()
+            _assert_page_invariants(engine)
+    run_to_completion(engine, reqs)
+    _assert_page_invariants(engine)
+    assert all(len(r.tokens()) == 4 for r in reqs)
+
+
+@pytest.mark.slow
+def test_paged_eviction_refcount_soak(params):
+    """Randomized admit/finish/evict churn on an under-provisioned pool:
+    every request completes with its full budget, no page is ever held
+    by two live non-sharing slots, freed pages are conserved, and
+    evictions actually happen."""
+    from skypilot_tpu.server import metrics
+    metrics.reset_for_tests()
+    try:
+        rng = np.random.default_rng(3)
+        engine = make_engine(params, n_slots=4, kv_pages=40,
+                             steps_per_call=2)
+        shared = [prompt_of(16), prompt_of(24)]
+        live = []
+        done = []
+        for round_i in range(60):
+            if rng.random() < 0.7:
+                if rng.random() < 0.5:
+                    base = shared[int(rng.integers(len(shared)))]
+                    prompt = base + rng.integers(
+                        1, CFG.vocab_size, 3).tolist()
+                else:
+                    prompt = prompt_of(int(rng.integers(4, 40)))
+                live.append(engine.submit(
+                    prompt, int(rng.integers(2, 8))))
+            for _ in range(int(rng.integers(1, 4))):
+                engine.step_pipelined()
+            # Invariants hold mid-flight every round.
+            _assert_page_invariants(engine)
+            still = []
+            for r in live:
+                (done if r.finished_at is not None else still).append(r)
+            live = still
+        run_to_completion(engine, live, step='step_pipelined')
+        engine.drain()
+        done += live
+        _assert_page_invariants(engine)
+        assert all(len(r.tokens()) == r.max_new_tokens for r in done)
+        text = metrics.render()
+        assert 'skytpu_engine_prefix_cache_evicted_pages_total' in text
+    finally:
+        metrics.reset_for_tests()
+
+
+# ----- serve-spec / env plumbing ---------------------------------------------
+def test_service_spec_kv_knobs_roundtrip():
+    from skypilot_tpu.serve.service_spec import ServiceSpec
+    spec = ServiceSpec.from_yaml_config({
+        'readiness_probe': '/health',
+        'replicas': 2,
+        'kv_page_size': 64,
+        'kv_pages': 512,
+        'prefix_cache': True,
+    })
+    assert spec.kv_page_size == 64
+    assert spec.kv_pages == 512
+    assert spec.prefix_cache is True
+    out = spec.to_yaml_config()
+    assert out['kv_page_size'] == 64 and out['prefix_cache'] is True
+    assert out['kv_pages'] == 512
+    again = ServiceSpec.from_yaml_config(out)
+    assert again.kv_page_size == 64 and again.prefix_cache is True
+    assert again.kv_pages == 512
+    # Defaults stay None and are omitted from the round trip.
+    plain = ServiceSpec.from_yaml_config({'readiness_probe': '/'})
+    assert plain.kv_page_size is None and plain.prefix_cache is None
+    assert plain.kv_pages is None
+    assert 'kv_page_size' not in plain.to_yaml_config()
+    assert 'kv_pages' not in plain.to_yaml_config()
+    assert 'prefix_cache' not in plain.to_yaml_config()
+
+
+def test_service_spec_prefix_cache_requires_paging():
+    from skypilot_tpu import exceptions
+    from skypilot_tpu.serve.service_spec import ServiceSpec
+    with pytest.raises(exceptions.InvalidTaskError,
+                       match='kv_page_size'):
+        ServiceSpec.from_yaml_config({
+            'readiness_probe': '/health',
+            'replicas': 1,
+            'prefix_cache': True,
+        })
+    with pytest.raises(exceptions.InvalidTaskError,
+                       match='kv_page_size'):
+        ServiceSpec.from_yaml_config({
+            'readiness_probe': '/health',
+            'replicas': 1,
+            'kv_pages': 128,
+        })
+
+
+def test_replica_task_env_carries_kv_knobs():
+    from skypilot_tpu.serve import replica_managers
+    from skypilot_tpu.serve.service_spec import ServiceSpec
+    from skypilot_tpu.task import Task
+
+    task = Task('svc', run='echo serve')
+    spec = ServiceSpec.from_yaml_config({
+        'readiness_probe': '/health', 'replicas': 1,
+        'kv_page_size': 32, 'kv_pages': 256, 'prefix_cache': False})
+    mgr = replica_managers.ReplicaManager.__new__(
+        replica_managers.ReplicaManager)
+    mgr.task = task
+    mgr.spec = spec
+    mgr.service_name = 'svc'
+    rt = mgr._replica_task(0, 8200, None, False)
+    assert rt.envs[replica_managers.ENV_REPLICA_KV_PAGE] == '32'
+    assert rt.envs[replica_managers.ENV_REPLICA_KV_PAGES] == '256'
+    assert rt.envs[replica_managers.ENV_REPLICA_PREFIX_CACHE] == '0'
+    # Unset: the envs are absent and the server keeps the contiguous
+    # layout.
+    mgr.spec = ServiceSpec.from_yaml_config(
+        {'readiness_probe': '/health', 'replicas': 1})
+    rt2 = mgr._replica_task(0, 8200, None, False)
+    assert replica_managers.ENV_REPLICA_KV_PAGE not in rt2.envs
+    assert replica_managers.ENV_REPLICA_KV_PAGES not in rt2.envs
+    assert replica_managers.ENV_REPLICA_PREFIX_CACHE not in rt2.envs
+
+
+def test_http_server_serves_with_paging(params):
+    """The inference server drives a paged+prefix-cached engine end to
+    end (headers, usage, deterministic output)."""
+    import asyncio
+    from aiohttp.test_utils import TestClient, TestServer
+    from skypilot_tpu.inference.server import build_app
+
+    engine = make_engine(params)
+    engine.start()
+
+    async def drive():
+        client = TestClient(TestServer(build_app(engine)))
+        await client.start_server()
+        try:
+            shared = list(range(1, 21))
+            r1 = await client.post(
+                '/v1/completions',
+                json={'prompt_ids': shared + [30], 'max_tokens': 4})
+            assert r1.status == 200
+            r2 = await client.post(
+                '/v1/completions',
+                json={'prompt_ids': shared + [31], 'max_tokens': 4})
+            assert r2.status == 200
+            assert len((await r2.json())['ids']) == 4
+        finally:
+            await client.close()
+
+    try:
+        asyncio.new_event_loop().run_until_complete(drive())
+    finally:
+        engine.stop()
+    assert engine.healthy

@@ -128,6 +128,16 @@ def test_fixture_findings_land_where_expected():
     span_hits = [f for f in by_rule['metric-naming']
                  if f.path == 'bad_spans.py']
     assert len(span_hits) == 3
+    # Paged-KV fixture: an unregistered page-cache gauge + counter and
+    # an unregistered prefix span — each caught (registry discipline
+    # covers the new families too).
+    page_hits = [f for f in by_rule['metric-naming']
+                 if f.path == 'bad_page_metrics.py']
+    assert len(page_hits) == 3
+    page_msgs = ' '.join(f.message for f in page_hits)
+    assert 'skytpu_engine_kv_rogue_pages' in page_msgs
+    assert 'skytpu_engine_prefix_cache_rogue_total' in page_msgs
+    assert 'engine.prefix_rogue' in page_msgs
 
 
 # ---------------------------------------------------------------------------
