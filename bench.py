@@ -620,6 +620,130 @@ def bench_slo_ramp(plateau_ticks: int = 12) -> dict:
     return out
 
 
+def bench_disagg(plateau_ticks: int = 8) -> dict:
+    """Disaggregated prefill/decode vs monolithic serving at EQUAL
+    chip budget (slo_sim phase-cost model — hermetic, chip-free).
+
+    Saturated mixed long/short traffic (canonical scenario constants
+    in serve/slo_sim.py, shared with the test twin): on a monolithic
+    pool the compute-bound prefill phase steals decode device time, so
+    TPOT breaches its SLO long before the chips run out of aggregate
+    throughput; splitting the same chips into a prefill pool and a
+    decode pool (KV pages handed off between them) isolates the
+    phases.  Reported per pool shape: TTFT/TPOT at the plateau, the
+    SLO-met request fraction over the whole ramp, and $-per-1k-SLO-met
+    (decode pool on spot — ThunderServe's cost lever).
+
+    Second half: the per-pool SLO autoscaler (DisaggSLOAutoscaler)
+    drives the pools through the ramp and one decode replica is
+    PREEMPTED mid-plateau.  With the spot pool's preemption headroom
+    the TPOT SLO holds through the preemption and the next tick's
+    re-plan restores the margin; the no-headroom counterfactual run
+    breaches on the preemption tick — both directions are pinned by
+    tests/test_readme_bench.py once this lands in an artifact.
+    """
+    from skypilot_tpu.serve import slo_sim
+
+    costs = slo_sim.DISAGG_COSTS
+    target_ttft = slo_sim.DISAGG_TARGET_TTFT_MS
+    target_tpot = slo_sim.DISAGG_TARGET_TPOT_MS
+    chips = slo_sim.DISAGG_TOTAL_CHIPS
+    tick = slo_sim.DISAGG_TICK_S
+    ramp = slo_sim.disagg_ramp(plateau_ticks)
+    price, spot_price = _chip_price_per_hr('v5e')
+    if not price:
+        price, spot_price = 1.2, 0.6       # nominal v5e list prices
+
+    svc = slo_sim.make_disagg_service()
+
+    def met(ttft_s, tpot_s):
+        return (ttft_s * 1e3 <= target_ttft and
+                tpot_s * 1e3 <= target_tpot)
+
+    def run_static(latency_fn, cost_per_hr):
+        met_req = total_req = 0
+        peak_lat = None
+        for qps in ramp:
+            ttft, tpot = latency_fn(qps)
+            n = qps * tick
+            total_req += n
+            if met(ttft, tpot):
+                met_req += n
+            peak_lat = (ttft, tpot)
+        hours = len(ramp) * tick / 3600.0
+        usd_per_1k = (cost_per_hr * hours / (met_req / 1e3)
+                      if met_req else None)
+        return {
+            'ttft_peak_ms': round(peak_lat[0] * 1e3, 2),
+            'tpot_peak_ms': round(peak_lat[1] * 1e3, 2),
+            'slo_met_frac': round(met_req / total_req, 3),
+            'cost_per_hr': round(cost_per_hr, 2),
+            'usd_per_1k_slo_met': (round(usd_per_1k, 4)
+                                   if usd_per_1k is not None else None),
+        }
+
+    mono = run_static(
+        lambda q: svc.latencies_monolithic(q, chips), chips * price)
+    # Equal-chip split sweep: every (prefill, decode) partition,
+    # decode pool on spot.  Best = most SLO-met requests, cheapest on
+    # ties (no silent cap: the full sweep lands in the JSON).
+    sweep = []
+    for n_prefill in range(1, chips):
+        n_decode = chips - n_prefill
+        cost = n_prefill * price + n_decode * spot_price
+        entry = run_static(
+            lambda q, p=n_prefill, d=n_decode:
+                svc.latencies_pools(q, p, d), cost)
+        entry.update(prefill_replicas=n_prefill,
+                     decode_replicas=n_decode)
+        sweep.append(entry)
+    best = max(sweep, key=lambda e: (e['slo_met_frac'],
+                                     -e['cost_per_hr']))
+
+    # --- preemption mid-plateau under the per-pool autoscaler --------
+    preempt_tick = len(ramp) - 3
+    hist = slo_sim.run_disagg_ramp(
+        slo_sim.make_disagg_autoscaler(spot_headroom=1),
+        slo_sim.make_disagg_service(), ramp, preempt_tick=preempt_tick)
+    after = hist[preempt_tick:]
+    preempt_max_tpot = max(t for _, _, _, _, t in after)
+    recovered = hist[preempt_tick + 1][2] >= hist[preempt_tick][2] + 1
+    # Counterfactual, static by construction: a decode pool sized
+    # EXACTLY to its SLO (the minimal size meeting the TPOT target at
+    # peak, no spot headroom) breaches the moment one replica
+    # preempts — the margin the headroom knob buys is load-bearing.
+    d_slo = next(d for d in range(1, chips + 1)
+                 if svc.latencies_pools(
+                     slo_sim.DISAGG_PEAK_QPS, 2, d)[1] * 1e3
+                 <= target_tpot)
+    no_headroom_max_tpot = svc.latencies_pools(
+        slo_sim.DISAGG_PEAK_QPS, 2, max(1, d_slo - 1))[1] * 1e3
+    return {
+        'total_chips': chips,
+        'peak_qps': slo_sim.DISAGG_PEAK_QPS,
+        'target_ttft_ms': target_ttft,
+        'target_tpot_ms': target_tpot,
+        'prompt_tokens': slo_sim.DISAGG_PROMPT_TOKENS,
+        'new_tokens': slo_sim.DISAGG_NEW_TOKENS,
+        'monolithic': mono,
+        'disagg': best,
+        'split_sweep': sweep,
+        # Headline keys (README claims pin on these):
+        'usd_per_1k_slo_met_monolithic': mono['usd_per_1k_slo_met'],
+        'usd_per_1k_slo_met_disagg': best['usd_per_1k_slo_met'],
+        'slo_met_frac_monolithic': mono['slo_met_frac'],
+        'slo_met_frac_disagg': best['slo_met_frac'],
+        'preemption_tick': preempt_tick,
+        'preemption_max_tpot_ms': round(preempt_max_tpot, 2),
+        'preemption_tpot_ok': preempt_max_tpot <= target_tpot,
+        'preemption_replan_restored_pool': recovered,
+        'no_headroom_preemption_tpot_ms': round(no_headroom_max_tpot,
+                                                2),
+        'no_headroom_preemption_breaches':
+            no_headroom_max_tpot > target_tpot,
+    }
+
+
 def bench_launch() -> dict:
     """Control-plane overhead: launch -> agent READY -> rank-0 start.
 
@@ -734,6 +858,9 @@ def main() -> None:
     # SLO-vs-QPS autoscaling comparison: pure-CPU virtual-replica
     # simulation (no device state to manage).
     serve['slo_ramp'] = bench_slo_ramp()
+    # Disaggregated prefill/decode vs monolithic at equal chip budget
+    # + spot decode-pool preemption resilience (slo_sim-backed).
+    serve['disagg'] = bench_disagg()
     # Flight-recorder overhead: ns/event + recorder-on vs -off
     # throughput on the identical workload (tracing is always-on in
     # production, so its cost is a headline, not a footnote).

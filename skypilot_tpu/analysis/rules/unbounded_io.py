@@ -29,7 +29,13 @@ from skypilot_tpu.analysis.core import (Finding, Project, Rule,
 
 _SCOPE = ('provision/', 'jobs/', 'clouds/', 'backends/', 'data/',
           'serve/', 'agent/', 'catalog/', 'authentication.py',
-          'controller_vm.py', 'utils/command_runner.py')
+          'controller_vm.py', 'utils/command_runner.py',
+          # Disaggregated serving: the KV-handoff push client and the
+          # inference server's prefill->decode relay are data-plane
+          # HTTP — a handoff with no deadline wedges the REQUEST (and
+          # its decode slot reservation) forever, exactly the failure
+          # this rule exists for.
+          'inference/')
 _REQUESTS_VERBS = ('get', 'post', 'put', 'delete', 'head', 'patch',
                    'request')
 _SUBPROCESS_BLOCKING = ('run', 'check_output', 'check_call', 'call')

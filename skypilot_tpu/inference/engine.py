@@ -72,6 +72,22 @@ dispatched against them has retired — no drain, serving never stops.
 This is what rolling weight refresh and the RL rollout/update
 alternation ride on.
 
+Disaggregated prefill/decode (`submit_prefill` / `submit_adopt`, paged
+engines only): pages are the KV-transfer unit.  A PREFILL-role request
+rides the ordinary admission/chunk/prefix-hit machinery with a
+one-token budget — the sampled first token arrives exactly as any
+other request's — and at retire its pages are gathered off the pool
+(one extra device call, synced by the SERVER thread, never the loop)
+into a transferable payload (inference/kv_transfer.py) instead of
+vanishing.  A DECODE-role engine adopts the payload: pages scatter
+into its own pool at page granularity (one fixed-shape dispatch, no
+per-token recompute), the slot starts at `length=prompt_len` with the
+sampled token as its last token, and from there the request is
+indistinguishable from one prefilled locally — greedy output is
+token-identical to monolithic serving.  Both paths keep the
+one-sync-per-step and zero-recompile contracts: export/adopt programs
+have one compiled shape each, and all new bookkeeping is host state.
+
 Tensor parallelism (13B-70B serving): pass `EngineConfig(mesh=...)`
 (parallel/mesh.py build_serve_mesh) and every program above runs
 mesh-sharded — params via the model's logical-axis annotations
@@ -175,6 +191,19 @@ class Request:
     # prefill: re-matching it would just re-pin the pages that starved
     # the pool (see _spill_stuck_hits).
     no_prefix: bool = False
+    # Disaggregated serving (paged engines only).  export=True marks a
+    # prefill-role request (submit_prefill): it runs with a one-token
+    # budget and, at retire, its prompt pages + sampled first token
+    # are gathered into `kv_export` for kv_transfer serialization
+    # instead of being dropped.  `downstream_max_new` is the token
+    # budget the DECODE replica will serve (travels in the payload;
+    # this engine never decodes it).  `adopt` carries a decode-role
+    # request's incoming state: (first_token, kv leaves as host numpy
+    # [n_kv_pages, H, page_size, D] in cache-tree leaf order).
+    export: bool = False
+    downstream_max_new: int = 0
+    kv_export: Optional[dict] = None
+    adopt: Optional[tuple] = None
 
     def tokens(self) -> List[int]:
         """Drain: block until the request finishes, return all tokens."""
@@ -297,6 +326,12 @@ class DecodeEngine:
         # hits divert here to ride the chunk machinery.
         self._ready_q: 'collections.deque' = collections.deque()
         self._hit_q: 'collections.deque' = collections.deque()
+        # Disaggregated serving: incoming KV-handoff adoptions (decode
+        # role).  Submitted into _adopt_q by the HTTP layer; the loop
+        # drains them into _adopt_ready and admits head-of-line as
+        # slots + pages free up (same retry discipline as _ready_q).
+        self._adopt_q: 'queue.Queue[Request]' = queue.Queue()
+        self._adopt_ready: 'collections.deque' = collections.deque()
         if self._paged:
             n_pages = (config.kv_pages if config.kv_pages is not None
                        else config.n_slots * self._pages_per_slot + 1)
@@ -679,11 +714,40 @@ class DecodeEngine:
             return (pool, last_toks.at[slot].set(first[0]),
                     lens.at[slot].set(total_len))
 
+        def export_pages(pool, pt_row):
+            """Disaggregation export: gather one slot's pages OFF the
+            pool as page stacks [P, H, ps, D] per leaf (P =
+            pages_per_slot; entries past the reservation gather the
+            trash page and are sliced away at serialization).  The
+            pool is read-only here — never donated — so the live cache
+            survives the export."""
+            return jax.tree_util.tree_map(lambda leaf: leaf[pt_row],
+                                          pool)
+
+        def adopt_insert(pool, last_toks, lens, data, scatter_row, slot,
+                         first, length):
+            """Disaggregation adopt: scatter a KV handoff's page
+            stacks into the pool at this request's freshly allocated
+            pages (scatter_row entries past the transferred pages
+            target the trash page, so the zero-padded stack rows land
+            somewhere harmless), and seed the slot's last token /
+            length so the next decode call continues the transferred
+            request exactly where the prefill replica's sampling left
+            it — no per-token recompute."""
+            def _ins(pool_leaf, data_leaf):
+                return pool_leaf.at[scatter_row].set(data_leaf)
+
+            pool = jax.tree_util.tree_map(_ins, pool, data)
+            return (pool, last_toks.at[slot].set(first),
+                    lens.at[slot].set(length))
+
         if self._paged:
             prefill_insert = prefill_insert_paged
             decode = decode_paged
             prefill_chunk_insert = chunk_insert_paged
             self._gather_raw = gather_prefix
+            self._export_raw = export_pages
+            self._adopt_raw = adopt_insert
         self._prefill_raw = prefill_insert
         self._decode_raw = decode
         self._chunk_raw = prefill_chunk
@@ -745,6 +809,10 @@ class DecodeEngine:
                                          donate_argnums=(1, 2, 3))
             # skytpu: allow-recompile(one fixed shape per engine; the pool is read-only here — donating it would free the live cache — and the page-table row is a tiny per-call upload)
             self._gather_prefix = jax.jit(self._gather_raw)
+            self._adopt_insert = jax.jit(self._adopt_raw,
+                                         donate_argnums=(0, 1, 2))
+            # skytpu: allow-recompile(one fixed shape per engine; the export gather reads the live pool — donating it would free the cache under the in-flight decode)
+            self._export_pages = jax.jit(self._export_raw)
             return
         p_sh, c_sh, r = (self._param_shardings, self._cache_shardings,
                          self._repl)
@@ -766,6 +834,16 @@ class DecodeEngine:
             out_shardings=(c_sh, r, r))
         self._gather_prefix = jax.jit(
             self._gather_raw, in_shardings=(c_sh, r), out_shardings=s_sh)
+        # Handoff programs: adopt data / export stacks are replicated
+        # (they cross the host boundary as numpy either way); the pool
+        # keeps its committed sharding through both.
+        d_sh = jax.tree.map(lambda _: r, c_sh)
+        self._adopt_insert = jax.jit(
+            self._adopt_raw, donate_argnums=(0, 1, 2),
+            in_shardings=(c_sh, r, r, d_sh, r, r, r, r),
+            out_shardings=(c_sh, r, r))
+        self._export_pages = jax.jit(
+            self._export_raw, in_shardings=(c_sh, r), out_shardings=d_sh)
 
     def _init_cache(self):
         """Materialize the big cache by tracing a dummy decode batch.
@@ -989,23 +1067,171 @@ class DecodeEngine:
             max_new_tokens = cache_len - len(prompt_ids)
         req = Request(list(prompt_ids), max_new_tokens,
                       request_id=request_id)
+        self._enqueue(req)
+        return req
+
+    def _enqueue(self, req: Request) -> None:
+        """Publish one validated request to the loop thread.  Every
+        flag the loop reads (export, adopt, no_prefix) must be set
+        BEFORE this — the loop may admit and even finish the request
+        the moment it lands in a queue."""
         with self._submit_lock:
             if self.error is not None:
                 raise RuntimeError(
                     f'decode engine is dead: {self.error!r}')
             # Prompts beyond the largest bucket take the chunked path.
-            if len(prompt_ids) > self.cfg.prefill_buckets[-1]:
+            if len(req.prompt_ids) > self.cfg.prefill_buckets[-1]:
                 self._long_q.put(req)
             else:
                 self._prefill_q.put(req)
-            self._queued_tokens += len(prompt_ids)
+            self._queued_tokens += len(req.prompt_ids)
         metrics_lib.inc_counter('skytpu_engine_requests_total')
-        return req
 
     def generate(self, prompt_ids: List[int],
                  max_new_tokens: int = 64) -> List[int]:
         """Synchronous helper: submit and wait."""
         return self.submit(prompt_ids, max_new_tokens).tokens()
+
+    # ----- disaggregated prefill/decode --------------------------------------
+    def submit_prefill(self, prompt_ids: List[int],
+                       max_new_tokens: int = 64,
+                       request_id: Optional[str] = None) -> Request:
+        """PREFILL-role admission: run the ordinary prefill machinery
+        (fused bucket, chunked, prefix-cache hits — identical compiled
+        programs), sample the first token, and HOLD the request's KV
+        pages for export instead of decoding.  The request finishes
+        after exactly one emitted token; `export_result` then yields
+        the pages + token for kv_transfer serialization.  Page
+        admission charges only ceil((prompt+1)/page) pages — the
+        decode budget is the DECODE pool's to reserve — which is the
+        packing win a dedicated prefill replica exists for.
+        `max_new_tokens` is the downstream decode budget and only
+        travels in the payload."""
+        if not self._paged:
+            raise RuntimeError(
+                'disaggregated prefill requires the paged KV cache '
+                '(kv_page_size): pages are the transfer unit')
+        limit = self.max_prompt_len
+        if len(prompt_ids) > limit:
+            raise ValueError(
+                f'prompt len {len(prompt_ids)} exceeds max_prompt_len '
+                f'{limit} (model max_seq_len '
+                f'{self.model.cfg.max_seq_len})')
+        req = Request(list(prompt_ids), 1, request_id=request_id)
+        req.export = True
+        req.downstream_max_new = max_new_tokens
+        self._enqueue(req)
+        return req
+
+    def export_result(self, req: Request) -> dict:
+        """The finished prefill-role request's transferable state:
+        {'first_token', 'prompt_len', 'n_kv_pages', 'leaves'} with
+        leaves as HOST numpy page stacks [n_kv_pages, H, page_size, D]
+        in cache-tree leaf order.  Call only after `req.tokens()`
+        returned (the loop thread dispatched the export gather before
+        finishing the request); the device->host sync happens HERE, on
+        the caller's thread, never the engine loop's."""
+        if req.kv_export is None:
+            raise RuntimeError(
+                'no export staged for this request (not submitted via '
+                'submit_prefill, not finished, or the engine died '
+                'mid-request)')
+        staged = req.kv_export
+        n_kv = staged['n_kv_pages']
+        if staged['leaves'] is None:
+            raise RuntimeError('export already consumed for this '
+                               'request')
+        leaves = [np.asarray(leaf)[:n_kv]
+                  for leaf in jax.tree_util.tree_leaves(staged['leaves'])]
+        # Drop the device-side gather now that the host copy exists:
+        # it holds a full slot's worth of KV HBM ([pages_per_slot,...]
+        # per leaf, whatever the prompt length), and the Request
+        # object lives until the HTTP push completes — N concurrent
+        # handoffs would otherwise pin N extra slots of HBM.
+        staged['leaves'] = None
+        return {'first_token': staged['first_token'],
+                'prompt_len': staged['prompt_len'],
+                'n_kv_pages': n_kv,
+                'leaves': leaves}
+
+    def submit_adopt(self, prompt_ids: List[int], first_token: int,
+                     kv_leaves: List[np.ndarray],
+                     max_new_tokens: int = 64,
+                     request_id: Optional[str] = None,
+                     page_size: Optional[int] = None) -> Request:
+        """DECODE-role admission of a KV handoff: the prompt's pages
+        were prefilled elsewhere; adopt them into this engine's pool
+        and continue decoding from the already-sampled first token.
+        The emitted stream (first token included, via the ordinary
+        row-0 mechanics) is token-identical to serving the prompt
+        monolithically.  `kv_leaves` are host numpy page stacks
+        [n_kv_pages, H, page_size, D] in cache-tree leaf order."""
+        if not self._paged:
+            raise RuntimeError(
+                'adopting a KV handoff requires the paged KV cache '
+                '(kv_page_size): pages are the transfer unit')
+        if page_size is not None and page_size != self._page_size:
+            raise ValueError(
+                f'kv handoff page size {page_size} != this engine\'s '
+                f'{self._page_size} — prefill and decode pools must '
+                f'agree on kv_page_size')
+        if not kv_leaves:
+            raise ValueError('kv handoff carries no cache leaves')
+        n_kv = kv_leaves[0].shape[0]
+        expect = -(-len(prompt_ids) // self._page_size)
+        if n_kv != expect:
+            raise ValueError(
+                f'kv handoff page count {n_kv} does not cover the '
+                f'{len(prompt_ids)}-token prompt (expected {expect} '
+                f'pages of {self._page_size})')
+        if n_kv > self._pages_per_slot:
+            raise ValueError(
+                f'kv handoff of {n_kv} pages exceeds this engine\'s '
+                f'{self._pages_per_slot} pages per slot '
+                f'(max_seq_len {self.model.cfg.max_seq_len})')
+        # The payload must match this engine's cache tree exactly —
+        # leaf count, per-page shape (heads, page_size, head_dim) and
+        # dtype.  A model-config mismatch rejected HERE is a 422 to
+        # the pusher; reaching the loop thread it would be an engine-
+        # killing crash that strands every in-flight request.
+        pool_leaves = jax.tree_util.tree_leaves(self._cache)
+        if len(kv_leaves) != len(pool_leaves):
+            raise ValueError(
+                f'kv handoff carries {len(kv_leaves)} cache leaves; '
+                f'this engine\'s cache tree has {len(pool_leaves)} '
+                f'(model mismatch between prefill and decode pools)')
+        for i, (leaf, pool_leaf) in enumerate(
+                zip(kv_leaves, pool_leaves)):
+            want_shape = tuple(pool_leaf.shape[1:])
+            if tuple(leaf.shape[1:]) != want_shape or \
+                    leaf.shape[0] != n_kv:
+                raise ValueError(
+                    f'kv handoff leaf {i} has page shape '
+                    f'{tuple(leaf.shape)}; this engine expects '
+                    f'[{n_kv}, {", ".join(map(str, want_shape))}] '
+                    f'(model mismatch between prefill and decode '
+                    f'pools)')
+            if leaf.dtype != pool_leaf.dtype:
+                raise ValueError(
+                    f'kv handoff leaf {i} dtype {leaf.dtype} != this '
+                    f'engine\'s {pool_leaf.dtype}')
+        cache_len = self.model.cfg.max_seq_len
+        if len(prompt_ids) + max_new_tokens > cache_len:
+            max_new_tokens = cache_len - len(prompt_ids)
+        if max_new_tokens < 1:
+            raise ValueError(
+                f'prompt of {len(prompt_ids)} tokens leaves no room '
+                f'to decode (max_seq_len {cache_len})')
+        req = Request(list(prompt_ids), max_new_tokens,
+                      request_id=request_id)
+        req.adopt = (int(first_token), kv_leaves)
+        with self._submit_lock:
+            if self.error is not None:
+                raise RuntimeError(
+                    f'decode engine is dead: {self.error!r}')
+            self._adopt_q.put(req)
+        metrics_lib.inc_counter('skytpu_engine_requests_total')
+        return req
 
     def drain(self) -> None:
         """Run the pipelined loop until FULLY idle: queues empty, no
@@ -1015,7 +1241,8 @@ class DecodeEngine:
         while (self._inflight is not None or
                not self._prefill_q.empty() or
                not self._long_q.empty() or
-               self._ready_q or self._hit_q or
+               not self._adopt_q.empty() or
+               self._ready_q or self._hit_q or self._adopt_ready or
                self._chunked is not None or
                any(s is not None for s in self._slots)):
             self.step_pipelined()
@@ -1203,6 +1430,22 @@ class DecodeEngine:
                          one, zero, self._next_rng())
         if self._paged and self._radix is not None:
             self._gather_prefix(self._cache, trash_row)
+        if self._paged:
+            # Handoff programs (disaggregated serving): one dummy
+            # export gather plus one adopt scatter whose rows all land
+            # in the trash page (slot 0's last/lens scribble is
+            # overwritten by the first real insert, like everything
+            # else prewarm touches).
+            self._export_pages(self._cache, trash_row)
+            zero_stacks = jax.tree.map(
+                lambda leaf: jnp.zeros(
+                    (self._pages_per_slot,) + tuple(leaf.shape[1:]),
+                    leaf.dtype), self._cache)
+            zero = jnp.zeros((), jnp.int32)
+            (self._cache, self._last_d,
+             self._lens_d) = self._adopt_insert(
+                 self._cache, self._last_d, self._lens_d, zero_stacks,
+                 trash_row, zero, zero, jnp.ones((), jnp.int32))
         if self._paged:
             _, self._cache, self._last_d, self._lens_d = self._decode(
                 self.params, self._cache, self._pt(), self._last_d,
@@ -1430,6 +1673,13 @@ class DecodeEngine:
                 emitted=req.emitted,
                 decode_s=(round(req.finished_at - req.first_token_at, 6)
                           if req.first_token_at is not None else None))
+        if req.export and slot.pages is not None:
+            # Stage the KV handoff BEFORE the terminating None: a
+            # caller whose tokens() returned may immediately read
+            # export_result.  The gather dispatch also precedes this
+            # retire's page release, so any later scatter into the
+            # freed pages is ordered behind it on device.
+            self._dispatch_export(slot)
         req.out.put(None)
         if slot.pages is not None:
             self._release_slot_pages(slot)
@@ -1462,6 +1712,106 @@ class DecodeEngine:
                                    slot.pages[:n_full])
         self._pool_alloc.release(slot.pages)
         slot.pages = None
+
+    def _dispatch_export(self, slot: _Slot) -> None:
+        """Stage a prefill-role request's pages for transfer: ONE
+        gather dispatch off the (read-only) pool, queued on device
+        ahead of this retire's page release — any later scatter into
+        the freed pages is ordered behind it, so the gathered values
+        are pre-overwrite by construction.  Only device ARRAYS land on
+        the Request here; the HTTP layer syncs them on ITS thread
+        (export_result) — the loop thread never blocks on the
+        device->host copy."""
+        req = slot.request
+        t0 = time.perf_counter()
+        leaves = self._export_pages(
+            self._cache, jnp.asarray(self._pt_row(slot.pages)))
+        t1 = time.perf_counter()
+        n_kv = -(-len(req.prompt_ids) // self._page_size)
+        req.kv_export = {
+            'leaves': leaves,
+            'first_token': int(slot.toks[0]) if slot.toks else 0,
+            'prompt_len': len(req.prompt_ids),
+            'n_kv_pages': n_kv,
+        }
+        metrics_lib.inc_counter('skytpu_engine_kv_exports_total')
+        if req.request_id is not None:
+            tracing.record_span(req.request_id, 'engine.kv_export',
+                                t0, t1, pages=n_kv)
+
+    def _step_adopt(self) -> None:
+        """Admit pending KV-handoff adoptions (decode role) into free
+        slots: allocate the request's full-lifetime pages — admission
+        charges ceil((prompt+max_new)/page) exactly like a local
+        prefill — scatter the transferred page stacks into them in ONE
+        fixed-shape dispatch, and seed the slot's last token / length
+        from the handoff.  Head-of-line on slot or page shortage;
+        retiring slots free both in order."""
+        if not self._paged:
+            return
+        while True:
+            try:
+                self._adopt_ready.append(self._adopt_q.get_nowait())
+            except queue.Empty:
+                break
+        while self._adopt_ready:
+            slot_id = next((i for i in range(self.cfg.n_slots)
+                            if self._slots[i] is None), None)
+            if slot_id is None:
+                return
+            req = self._adopt_ready[0]
+            pages = self._alloc_pages(self._pages_needed(req))
+            if pages is None:
+                return
+            self._adopt_ready.popleft()
+            first_token, kv_leaves = req.adopt
+            n_kv = kv_leaves[0].shape[0]
+            t0 = time.perf_counter()
+            # Full-height page stacks (pages_per_slot rows) keep the
+            # adopt program at ONE compiled shape; rows past the
+            # transfer are zeros and scatter into the trash page.
+            padded = []
+            for leaf in kv_leaves:
+                buf = np.zeros(
+                    (self._pages_per_slot,) + tuple(leaf.shape[1:]),
+                    leaf.dtype)
+                buf[:n_kv] = leaf
+                padded.append(jnp.asarray(buf))
+            data = jax.tree_util.tree_unflatten(
+                jax.tree_util.tree_structure(self._cache), padded)
+            scatter_row = np.full((self._pages_per_slot,), TRASH_PAGE,
+                                  np.int32)
+            scatter_row[:n_kv] = pages[:n_kv]
+            row = self._pt_row(pages)
+            (self._cache, self._last_d,
+             self._lens_d) = self._adopt_insert(
+                 self._cache, self._last_d, self._lens_d, data,
+                 jnp.asarray(scatter_row),
+                 jnp.asarray(slot_id, jnp.int32),
+                 jnp.asarray(first_token, jnp.int32),
+                 jnp.asarray(len(req.prompt_ids), jnp.int32))
+            t1 = time.perf_counter()
+            self._slots[slot_id] = _Slot(req, len(req.prompt_ids),
+                                         pages=pages)
+            self._page_tables[slot_id] = row
+            self._pt_dirty = True
+            if self._radix is not None:
+                # Adopted prompt pages join the radix cache like
+                # locally prefilled ones: decode-pool multi-turn
+                # replays hit through the transferred prefix.  Full
+                # pages only — decode writes land strictly past them.
+                n_full = len(req.prompt_ids) // self._page_size
+                if n_full:
+                    self._radix.insert(
+                        req.prompt_ids[:n_full * self._page_size],
+                        pages[:n_full])
+            metrics_lib.inc_counter('skytpu_engine_kv_adopts_total')
+            if req.request_id is not None:
+                tracing.record_span(req.request_id, 'engine.queue_wait',
+                                    req.submitted_at, t0)
+                tracing.record_span(req.request_id, 'engine.kv_adopt',
+                                    t0, t1, slot=slot_id, pages=n_kv)
+            req.prefill_end_at = t1
 
     def _admit_free(self, handoff: Optional[List[int]] = None) -> None:
         """Admit queued requests into free slots (grouped per bucket —
@@ -1725,7 +2075,8 @@ class DecodeEngine:
         the idle 1 kHz loop does not hammer the registry lock."""
         sample = (n_active,
                   self._prefill_q.qsize() + self._long_q.qsize() +
-                  len(self._ready_q) + len(self._hit_q),
+                  len(self._ready_q) + len(self._hit_q) +
+                  self._adopt_q.qsize() + len(self._adopt_ready),
                   self._queued_tokens,
                   self._pool_alloc.free_pages if self._paged else -1)
         if sample == self._last_gauges:
@@ -1753,6 +2104,7 @@ class DecodeEngine:
         the host work with the next device call."""
         self._install_staged()
         self._step_chunked()
+        self._step_adopt()
         self._admit_free()
         active = [i for i in range(self.cfg.n_slots)
                   if self._slots[i] is not None]
@@ -1828,6 +2180,7 @@ class DecodeEngine:
                              slot.request.emitted)
                 if remaining <= rows_to_come:
                     handoff.append(i)
+        self._step_adopt()
         self._admit_free(handoff)
         return len(active) + (1 if chunked else 0)
 
@@ -1873,9 +2226,11 @@ class DecodeEngine:
             for t in range(start, out.shape[0]):
                 tok = int(out[t, i])
                 slot.length += 1
-                if slot.pages is not None and self._radix is not None:
+                if slot.pages is not None:
                     # Retire donates prompt+generated pages to the
-                    # prefix cache; it needs the generated token ids.
+                    # prefix cache (it needs the generated token ids)
+                    # and a prefill-role request's KV export needs its
+                    # sampled first token.
                     slot.toks.append(tok)
                 self._emit(slot.request, tok)
                 emitted += 1
@@ -1922,12 +2277,15 @@ class DecodeEngine:
                         cp.request.finished_at = time.perf_counter()
                         cp.request.out.put(None)
                     for req in list(self._ready_q) + \
-                            [h[0] for h in self._hit_q]:
+                            [h[0] for h in self._hit_q] + \
+                            list(self._adopt_ready):
                         req.finished_at = time.perf_counter()
                         req.out.put(None)
                     self._ready_q.clear()
                     self._hit_q.clear()
-                    for pending in (self._prefill_q, self._long_q):
+                    self._adopt_ready.clear()
+                    for pending in (self._prefill_q, self._long_q,
+                                    self._adopt_q):
                         while True:
                             try:
                                 req = pending.get_nowait()

@@ -1,0 +1,218 @@
+"""KV-page handoff between prefill and decode replicas (disaggregated
+serving, ThunderServe arXiv:2502.09334).
+
+A prefill replica runs the ordinary chunked/fused prefill into its
+paged KV pool (PR 12 made pages the transferable unit), then exports
+the request's pages plus the sampled first token as ONE compact binary
+payload; the decode replica adopts the pages into its own pool at page
+granularity — no per-token recompute — and continues decoding.  This
+module owns the wire format and the bounded-timeout HTTP push; it is
+deliberately jax-free (pure numpy + stdlib) so the serve LB can import
+its header constants without dragging in a device runtime.
+
+Wire format (version 1, little-endian):
+
+    MAGIC 'SKVT1' | u32 header_len | header JSON (utf-8) | page data
+
+The header carries dtype/shape per cache leaf, the page geometry, the
+prompt ids, the sampled first token and a CRC32 of the page data —
+a truncated or corrupted transfer fails loudly at parse time instead
+of decoding garbage.  Page data is LAYER-MAJOR: all of leaf 0's pages
+(``[n_pages, heads, page_size, head_dim]``, C-contiguous), then leaf
+1's, matching ``jax.tree.leaves`` order of the engine's cache tree —
+both engines run the same model so the leaf order is identical by
+construction (and the leaf count/shapes are checked at adopt).
+
+Push/pull: the serve LB stamps ``X-Skytpu-Decode-Url`` (one or more
+candidate decode replicas, comma-separated, ranked by its routing
+policy) on the request it proxies to the prefill pool; the prefill
+replica POSTs the payload to ``/v1/kv_adopt`` on the first candidate
+that accepts, with a hard client timeout — a dead decode replica fails
+the push in bounded time and the NEXT candidate gets the same payload
+(re-route, no re-prefill).  Transfer outcomes land in the
+``skytpu_lb_kv_transfer_*`` families, federated like every other
+serve metric.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+import zlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.server import metrics as metrics_lib
+
+logger = sky_logging.init_logger(__name__)
+
+_MAGIC = b'SKVT1'
+VERSION = 1
+
+# Stamped by the serve LB on requests proxied to the PREFILL pool: the
+# decode replicas (comma-separated URLs, in routing-policy preference
+# order) the prefill replica should push this request's KV pages to.
+DECODE_URL_HEADER = 'X-Skytpu-Decode-Url'
+# Route the decode replica accepts handoff payloads on.
+ADOPT_ROUTE = '/v1/kv_adopt'
+
+# Hard deadline for one handoff push (connect + upload + the decode
+# replica's FULL generation, since the adopt response carries the
+# completion).  Generous — streaming decodes legitimately run long —
+# but finite: a wedged decode replica must fail the push so the next
+# candidate (or the local monolithic fallback) gets the request.
+DEFAULT_PUSH_TIMEOUT_SECONDS = 300.0
+# The transfer itself (connect + request write) gets a much tighter
+# bound: payloads are MBs, not streams, and a transfer that cannot
+# start quickly should fail over to the next candidate.
+DEFAULT_CONNECT_TIMEOUT_SECONDS = 10.0
+
+
+@dataclasses.dataclass
+class KVHandoff:
+    """One request's transferable prefill state."""
+    prompt_ids: List[int]
+    first_token: int
+    max_new_tokens: int
+    page_size: int
+    # Per cache leaf: [n_kv_pages, heads, page_size, head_dim] numpy,
+    # jax.tree.leaves order.
+    leaves: List[np.ndarray]
+    request_id: Optional[str] = None
+
+    @property
+    def n_kv_pages(self) -> int:
+        return self.leaves[0].shape[0] if self.leaves else 0
+
+
+def serialize(handoff: KVHandoff) -> bytes:
+    """KVHandoff -> one self-describing binary payload."""
+    blobs = []
+    leaf_meta = []
+    for leaf in handoff.leaves:
+        arr = np.ascontiguousarray(leaf)
+        blobs.append(arr.tobytes())
+        leaf_meta.append({'shape': list(arr.shape),
+                          'dtype': arr.dtype.name})
+    data = b''.join(blobs)
+    header = {
+        'version': VERSION,
+        'prompt_ids': list(map(int, handoff.prompt_ids)),
+        'first_token': int(handoff.first_token),
+        'max_new_tokens': int(handoff.max_new_tokens),
+        'page_size': int(handoff.page_size),
+        'request_id': handoff.request_id,
+        'leaves': leaf_meta,
+        'data_bytes': len(data),
+        'crc32': zlib.crc32(data) & 0xffffffff,
+    }
+    hdr = json.dumps(header, separators=(',', ':')).encode('utf-8')
+    return b''.join([_MAGIC, len(hdr).to_bytes(4, 'little'), hdr, data])
+
+
+def deserialize(payload: bytes) -> KVHandoff:
+    """Parse + integrity-check one payload; raises ValueError on any
+    corruption (magic, truncation, checksum, shape mismatch) — a bad
+    transfer must never scatter garbage into a live KV pool."""
+    if len(payload) < len(_MAGIC) + 4 or \
+            payload[:len(_MAGIC)] != _MAGIC:
+        raise ValueError('kv-handoff payload: bad magic')
+    off = len(_MAGIC)
+    hdr_len = int.from_bytes(payload[off:off + 4], 'little')
+    off += 4
+    if len(payload) < off + hdr_len:
+        raise ValueError('kv-handoff payload: truncated header')
+    try:
+        header = json.loads(payload[off:off + hdr_len].decode('utf-8'))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise ValueError(f'kv-handoff payload: unparseable header: {e}')
+    if header.get('version') != VERSION:
+        raise ValueError(f'kv-handoff payload: version '
+                         f'{header.get("version")} != {VERSION}')
+    off += hdr_len
+    data = payload[off:]
+    if len(data) != header['data_bytes']:
+        raise ValueError(
+            f'kv-handoff payload: data truncated '
+            f'({len(data)} of {header["data_bytes"]} bytes)')
+    if (zlib.crc32(data) & 0xffffffff) != header['crc32']:
+        raise ValueError('kv-handoff payload: checksum mismatch')
+    leaves = []
+    pos = 0
+    for meta in header['leaves']:
+        shape = tuple(meta['shape'])
+        dtype = np.dtype(meta['dtype'])
+        n = int(np.prod(shape)) * dtype.itemsize
+        leaves.append(np.frombuffer(
+            data, dtype=dtype, count=int(np.prod(shape)),
+            offset=pos).reshape(shape))
+        pos += n
+    if pos != len(data):
+        raise ValueError('kv-handoff payload: leaf sizes do not cover '
+                         'the data section')
+    return KVHandoff(prompt_ids=header['prompt_ids'],
+                     first_token=header['first_token'],
+                     max_new_tokens=header['max_new_tokens'],
+                     page_size=header['page_size'],
+                     leaves=leaves,
+                     request_id=header.get('request_id'))
+
+
+def parse_decode_targets(header_value: Optional[str]) -> List[str]:
+    """The LB's decode-candidate header -> ordered URL list."""
+    if not header_value:
+        return []
+    return [u.strip() for u in header_value.split(',') if u.strip()]
+
+
+async def push(session, decode_urls: Sequence[str], payload: bytes,
+               request_id: Optional[str] = None,
+               timeout_s: float = DEFAULT_PUSH_TIMEOUT_SECONDS,
+               ) -> Tuple[Optional[Dict], Optional[str]]:
+    """Push one payload to the first decode replica that takes it.
+
+    Tries ``decode_urls`` in order (the LB ranked them); a candidate
+    that fails — connect refused, timeout, non-200 — is skipped and the
+    SAME payload goes to the next one: re-routing an in-flight handoff
+    costs one RPC, never a re-prefill.  Returns (decode replica's JSON
+    completion, winning URL), or (None, None) when every candidate
+    failed (the caller falls back to monolithic serving).
+    """
+    import aiohttp
+    headers = {'Content-Type': 'application/octet-stream'}
+    if request_id:
+        from skypilot_tpu.server import tracing
+        headers[tracing.TRACE_HEADER] = request_id
+    for url in decode_urls:
+        t0 = time.perf_counter()
+        outcome = 'error'
+        try:
+            async with session.post(
+                    url.rstrip('/') + ADOPT_ROUTE, data=payload,
+                    headers=headers,
+                    timeout=aiohttp.ClientTimeout(
+                        total=timeout_s,
+                        sock_connect=DEFAULT_CONNECT_TIMEOUT_SECONDS,
+                    )) as resp:
+                if resp.status == 200:
+                    body = await resp.json()
+                    outcome = 'ok'
+                    metrics_lib.inc_counter(
+                        'skytpu_lb_kv_transfer_bytes_total',
+                        float(len(payload)))
+                    return body, url
+                logger.warning(
+                    f'kv handoff to {url} rejected: {resp.status}')
+        except Exception as e:  # pylint: disable=broad-except
+            # aiohttp client errors, timeouts, DNS — all mean "this
+            # candidate is out"; the next one gets the payload.
+            logger.warning(f'kv handoff to {url} failed: {e}')
+        finally:
+            metrics_lib.inc_counter('skytpu_lb_kv_transfer_total',
+                                    outcome=outcome)
+            metrics_lib.observe_hist(
+                'skytpu_lb_kv_transfer_seconds',
+                time.perf_counter() - t0, outcome=outcome)
+    return None, None

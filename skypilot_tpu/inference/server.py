@@ -18,6 +18,24 @@ launched by examples/tpu/v6e/serve-llama2-7b.yaml).  Routes:
                         max_prompt_len, default max_seq_len - 1); a
                         prompt beyond that limit gets 413 with the
                         limit in the body.
+- POST /v1/kv_adopt     Disaggregated serving: a prefill replica's
+                        KV-handoff payload (inference/kv_transfer.py
+                        binary format).  The engine adopts the pages
+                        into its own pool and decodes; the response is
+                        the SAME completion JSON /v1/completions
+                        returns, so the prefill replica can relay it
+                        verbatim.
+
+Roles (`--role`, env SKYTPU_SERVE_ROLE): `monolithic` (default) serves
+each request end to end.  A `prefill` replica, when the serve LB
+stamps X-Skytpu-Decode-Url with decode-pool candidates, runs only the
+prefill phase and PUSHES the paged KV + sampled first token to the
+first candidate that accepts (bounded timeout; a dead candidate fails
+over to the next — the payload is re-routed, never re-prefilled).  If
+every candidate fails it falls back to serving monolithically, and the
+re-prefill hits its own prefix cache (the prompt pages were donated at
+export).  A `decode` replica accepts /v1/kv_adopt.  Both roles run the
+full engine, so a mis-routed request still completes.
 - GET  /debug/requests        -> flight-recorder summaries (recent
                          request ids + their span names).
 - GET  /debug/requests/<id>   -> one request's span events + TTFT
@@ -46,9 +64,11 @@ import asyncio
 import os
 from typing import List
 
+import aiohttp
 from aiohttp import web
 
 from skypilot_tpu import sky_logging
+from skypilot_tpu.inference import kv_transfer
 from skypilot_tpu.inference.engine import DecodeEngine, EngineConfig
 from skypilot_tpu.server import metrics as metrics_lib
 from skypilot_tpu.server import tracing
@@ -72,7 +92,20 @@ def decode_bytes(ids: List[int]) -> str:
 BACKLOG_HEADER = metrics_lib.BACKLOG_HEADER
 
 
-def build_app(engine: DecodeEngine) -> web.Application:
+def build_app(engine: DecodeEngine,
+              role: str = 'monolithic') -> web.Application:
+    # One pooled client session for KV-handoff pushes, created lazily
+    # on the app's own event loop and closed with the app.
+    _state = {'session': None}
+
+    def _session() -> aiohttp.ClientSession:
+        if _state['session'] is None or _state['session'].closed:
+            _state['session'] = aiohttp.ClientSession()
+        return _state['session']
+
+    async def _close_session(_app):
+        if _state['session'] is not None and not _state['session'].closed:
+            await _state['session'].close()
 
     @web.middleware
     async def stamp_backlog(request: web.Request, handler):
@@ -88,29 +121,37 @@ def build_app(engine: DecodeEngine) -> web.Application:
         resp.headers[tracing.TRACE_HEADER] = rid
         return resp
 
-    app = web.Application(middlewares=[stamp_backlog])
+    # aiohttp's default client_max_size is 1 MiB — a KV-handoff
+    # payload (layer-major pages of a real model) is tens to hundreds
+    # of MB, so the default would 413 every /v1/kv_adopt and silently
+    # degrade disaggregation to permanent monolithic fallback.
+    max_payload = int(os.environ.get('SKYTPU_SERVE_MAX_PAYLOAD_BYTES',
+                                     str(2 * 1024 ** 3)))
+    app = web.Application(middlewares=[stamp_backlog],
+                          client_max_size=max_payload)
 
     async def health(_request):
         if not engine.healthy:
             return web.json_response(
-                {'status': 'error', 'error': repr(engine.error)},
-                status=503)
-        return web.json_response({'status': 'ok'})
+                {'status': 'error', 'error': repr(engine.error),
+                 'role': role}, status=503)
+        return web.json_response({'status': 'ok', 'role': role})
 
-    async def completions(request):
-        try:
-            body = await request.json()
-        except Exception:  # pylint: disable=broad-except
-            return web.json_response({'error': 'invalid JSON'}, status=400)
-        ids = body.get('prompt_ids')
-        if ids is None:
-            prompt = body.get('prompt')
-            if not isinstance(prompt, str):
-                return web.json_response(
-                    {'error': 'need "prompt" or "prompt_ids"'}, status=400)
-            ids = encode_bytes(prompt)
-        max_tokens = int(body.get('max_tokens', 64))
-        rid = request['skytpu_request_id']
+    def _completion_json(rid, ids, out, req):
+        return {
+            'ids': out,
+            'text': decode_bytes(out),
+            'request_id': rid,
+            'usage': {
+                'prompt_tokens': len(ids),
+                'completion_tokens': len(out),
+                'ttft_ms': round(
+                    (req.first_token_at - req.submitted_at) * 1e3, 2)
+                if req.first_token_at else None,
+            },
+        }
+
+    async def _serve_monolithic(ids, max_tokens, rid):
         try:
             req = engine.submit(ids, max_tokens, request_id=rid)
         except ValueError as e:
@@ -126,18 +167,103 @@ def build_app(engine: DecodeEngine) -> web.Application:
                  'max_prompt_len': engine.max_prompt_len}, status=413)
         out = await asyncio.get_event_loop().run_in_executor(
             None, req.tokens)
-        return web.json_response({
-            'ids': out,
-            'text': decode_bytes(out),
-            'request_id': rid,
-            'usage': {
-                'prompt_tokens': len(ids),
-                'completion_tokens': len(out),
-                'ttft_ms': round(
-                    (req.first_token_at - req.submitted_at) * 1e3, 2)
-                if req.first_token_at else None,
-            },
-        })
+        return web.json_response(_completion_json(rid, ids, out, req))
+
+    def _export_payload(req, ids, max_tokens, rid):
+        """Executor-thread half of a handoff: the device->host copy of
+        the gathered pages plus serialization — never on the event
+        loop, never on the engine loop."""
+        exported = engine.export_result(req)
+        return kv_transfer.serialize(kv_transfer.KVHandoff(
+            prompt_ids=ids,
+            first_token=exported['first_token'],
+            max_new_tokens=max_tokens,
+            page_size=engine.cfg.kv_page_size,
+            leaves=exported['leaves'],
+            request_id=rid))
+
+    async def _serve_prefill_handoff(ids, max_tokens, rid, targets):
+        """Prefill role: run the prefill phase locally, push the KV
+        pages + first token to a decode candidate, relay its
+        completion.  Every failure falls back one level: next decode
+        candidate, then monolithic serving on this replica (whose
+        re-prefill hits the prefix cache — export donated the prompt
+        pages)."""
+        loop = asyncio.get_event_loop()
+        try:
+            req = engine.submit_prefill(ids, max_tokens, request_id=rid)
+        except ValueError as e:
+            tracing.record_instant(rid, 'server.reject', status=413,
+                                   prompt_tokens=len(ids),
+                                   max_prompt_len=engine.max_prompt_len)
+            return web.json_response(
+                {'error': str(e),
+                 'max_prompt_len': engine.max_prompt_len}, status=413)
+        await loop.run_in_executor(None, req.tokens)
+        if req.kv_export is None:
+            # Engine died mid-prefill; serve the error like any other.
+            return web.json_response(
+                {'error': f'prefill failed: {engine.error!r}'},
+                status=503)
+        payload = await loop.run_in_executor(
+            None, _export_payload, req, ids, max_tokens, rid)
+        body, url = await kv_transfer.push(_session(), targets, payload,
+                                           request_id=rid)
+        if body is not None:
+            body['request_id'] = rid
+            body['disaggregated'] = True
+            body['decode_url'] = url
+            return web.json_response(body)
+        logger.warning(f'every decode candidate failed for {rid}; '
+                       f'serving monolithically')
+        return await _serve_monolithic(ids, max_tokens, rid)
+
+    async def completions(request):
+        try:
+            body = await request.json()
+        except Exception:  # pylint: disable=broad-except
+            return web.json_response({'error': 'invalid JSON'}, status=400)
+        ids = body.get('prompt_ids')
+        if ids is None:
+            prompt = body.get('prompt')
+            if not isinstance(prompt, str):
+                return web.json_response(
+                    {'error': 'need "prompt" or "prompt_ids"'}, status=400)
+            ids = encode_bytes(prompt)
+        max_tokens = int(body.get('max_tokens', 64))
+        rid = request['skytpu_request_id']
+        targets = kv_transfer.parse_decode_targets(
+            request.headers.get(kv_transfer.DECODE_URL_HEADER))
+        if role == 'prefill' and targets and engine.cfg.kv_page_size:
+            return await _serve_prefill_handoff(ids, max_tokens, rid,
+                                                targets)
+        return await _serve_monolithic(ids, max_tokens, rid)
+
+    async def kv_adopt(request):
+        """Decode role: adopt a prefill replica's KV handoff and
+        decode it to completion.  The response is the completions JSON
+        so the pushing replica relays it verbatim."""
+        raw = await request.read()
+        rid = request['skytpu_request_id']
+        try:
+            handoff = kv_transfer.deserialize(raw)
+        except ValueError as e:
+            return web.json_response({'error': str(e)}, status=400)
+        try:
+            req = engine.submit_adopt(
+                handoff.prompt_ids, handoff.first_token, handoff.leaves,
+                handoff.max_new_tokens, request_id=rid,
+                page_size=handoff.page_size)
+        except ValueError as e:
+            # Geometry mismatch (page size/count): this replica cannot
+            # serve the payload — 422 tells the pusher to try another.
+            return web.json_response({'error': str(e)}, status=422)
+        except RuntimeError as e:
+            return web.json_response({'error': str(e)}, status=503)
+        out = await asyncio.get_event_loop().run_in_executor(
+            None, req.tokens)
+        return web.json_response(
+            _completion_json(rid, handoff.prompt_ids, out, req))
 
     async def metrics_route(_request):
         return web.Response(text=metrics_lib.render(),
@@ -150,6 +276,8 @@ def build_app(engine: DecodeEngine) -> web.Application:
     app.router.add_get('/debug/requests', debug_requests)
     app.router.add_get('/debug/requests/{request_id}', debug_request)
     app.router.add_post('/v1/completions', completions)
+    app.router.add_post(kv_transfer.ADOPT_ROUTE, kv_adopt)
+    app.on_cleanup.append(_close_session)
     return app
 
 
@@ -206,6 +334,18 @@ def main() -> None:
         'them.  Serve specs set it via service.prefix_cache '
         '(SKYTPU_SERVE_PREFIX_CACHE).')
     parser.add_argument(
+        '--role', choices=('monolithic', 'prefill', 'decode'),
+        default=os.environ.get('SKYTPU_SERVE_ROLE', 'monolithic'),
+        help='disaggregated serving role (requires --kv-page-size: '
+        'pages are the KV-transfer unit).  `prefill` replicas run '
+        'only the prefill phase when the serve LB names decode '
+        'candidates (X-Skytpu-Decode-Url) and push the paged KV + '
+        'first token to one of them; `decode` replicas accept '
+        '/v1/kv_adopt.  Both run the full engine, so a mis-routed '
+        'request still completes.  Serve specs set the pools via '
+        'service.disaggregation, which arrives here as '
+        'SKYTPU_SERVE_ROLE.')
+    parser.add_argument(
         '--checkpoint', default=None,
         help='orbax checkpoint dir (local path or gs://bucket/prefix); '
         'restores trained params instead of random init')
@@ -260,13 +400,21 @@ def main() -> None:
     # XLA compile would stall the whole decode batch for seconds.
     engine.prewarm()
     engine.start()
+    if args.role != 'monolithic' and not args.kv_page_size:
+        # A roled replica without paging cannot hand KV off; serve
+        # monolithically rather than advertise a capability it lacks.
+        logger.warning(f'--role {args.role} requires --kv-page-size; '
+                       f'serving monolithically')
+        args.role = 'monolithic'
     logger.info(f'serving {args.model} on :{args.port} '
                 f'({args.n_slots} slots, tensor={args.tensor}, '
+                f'role={args.role}, '
                 f'kv_page_size={args.kv_page_size or "off"}, '
                 f'prefix_cache='
                 f'{bool(args.prefix_cache and args.kv_page_size)}, '
                 f'checkpoint={args.checkpoint or "random-init"})')
-    web.run_app(build_app(engine), port=args.port, print=None)
+    web.run_app(build_app(engine, role=args.role), port=args.port,
+                print=None)
 
 
 if __name__ == '__main__':

@@ -44,10 +44,18 @@ class Autoscaler:
         self.qps_window_seconds = qps_window_seconds
         self.target_num_replicas = spec.min_replicas
 
+    # True on policies deciding per disaggregated pool: the controller
+    # calls evaluate_pools and applies each pool's delta with
+    # manager.scale_up/scale_down(role=...).
+    is_pool_autoscaler = False
+
     @classmethod
     def make(cls, spec: ServiceSpec,
              decision_interval_seconds: float,
              qps_window_seconds: float = QPS_WINDOW_SECONDS) -> 'Autoscaler':
+        if spec.disaggregation is not None:
+            return DisaggSLOAutoscaler(spec, decision_interval_seconds,
+                                       qps_window_seconds)
         if spec.slo_autoscaling_enabled:
             return SLOAutoscaler(spec, decision_interval_seconds,
                                  qps_window_seconds)
@@ -377,3 +385,236 @@ class SLOAutoscaler(RequestRateAutoscaler):
         else:
             desired = max(qps_desired, self.target_num_replicas)
         return self._apply_hysteresis(desired, num_live_replicas)
+
+
+@dataclasses.dataclass
+class PoolDecision:
+    """Per-pool deltas for one disaggregated tick."""
+    prefill: AutoscalerDecision
+    decode: AutoscalerDecision
+
+
+class _PoolState:
+    """One pool's scaling state: bounds, hysteresis counters, and the
+    spot preemption headroom (replicas held ABOVE the SLO-driven
+    target so one preemption degrades margin, not the SLO, while the
+    re-plan provisions a replacement)."""
+
+    def __init__(self, lo: int, hi: int, upscale_threshold: int,
+                 downscale_threshold: int, headroom: int) -> None:
+        self.lo, self.hi = lo, hi
+        self.upscale_threshold = upscale_threshold
+        self.downscale_threshold = downscale_threshold
+        self.headroom = headroom
+        # SLO-driven target, WITHOUT headroom (decision() adds it).
+        self.target = lo
+        self.upscale_counter = 0
+        self.downscale_counter = 0
+
+    def commit(self, desired: int) -> None:
+        """Same counter hysteresis as RequestRateAutoscaler, per
+        pool."""
+        desired = max(self.lo, min(self.hi, desired))
+        if desired > self.target:
+            self.upscale_counter += 1
+            self.downscale_counter = 0
+            if self.upscale_counter >= self.upscale_threshold:
+                self.target = desired
+                self.upscale_counter = 0
+        elif desired < self.target:
+            self.downscale_counter += 1
+            self.upscale_counter = 0
+            if self.downscale_counter >= self.downscale_threshold:
+                self.target = desired
+                self.downscale_counter = 0
+        else:
+            self.upscale_counter = 0
+            self.downscale_counter = 0
+
+    def decision(self, live: int) -> AutoscalerDecision:
+        total = min(self.hi, self.target + self.headroom)
+        return AutoscalerDecision(total, total - live)
+
+    def adopt(self, old: '_PoolState') -> None:
+        self.target = max(self.lo, min(self.hi, old.target))
+        self.upscale_counter = old.upscale_counter
+        self.downscale_counter = old.downscale_counter
+
+
+class DisaggSLOAutoscaler(Autoscaler):
+    """Per-pool SLO scaling for disaggregated prefill/decode serving
+    (ThunderServe, arXiv:2502.09334: size each phase's pool by its own
+    latency signal, place each pool by its own cost profile).
+
+    The phase split makes attribution trivial: TTFT is made in the
+    PREFILL pool (queue + prefill + handoff), TPOT in the DECODE pool
+    (batch bandwidth) — so one federated scrape drives two independent
+    decisions:
+
+      - p95 TTFT over target (or prefill-token backlog over
+        max_queue_tokens_per_replica x live prefill) -> prefill +1;
+      - p95 TPOT over target -> decode +1, with QPS demand
+        (ceil(qps / target_qps_per_replica)) as the decode pool's
+        fallback/floor signal — decode slots are what requests occupy;
+      - scale-down per pool only when the load-proportional projection
+        of ITS p95 at the shrunken count still meets ITS target (the
+        same conservative model as SLOAutoscaler);
+      - a spot pool holds `spot_headroom` extra replicas, so a
+        preemption mid-traffic spends margin instead of breaching the
+        SLO; the next tick's delta restores the margin (the
+        lightweight re-plan).
+
+    Without SLO targets the pools hold their configured base sizes
+    (plus spot headroom) — fixed-size disaggregation.
+    """
+
+    wants_lb_scrape = True
+    is_pool_autoscaler = True
+
+    TTFT_FAMILY = _metrics_names.ENGINE_TTFT_FAMILY
+    TPOT_FAMILY = _metrics_names.ENGINE_TPOT_FAMILY
+    BACKLOG_FAMILY = _metrics_names.QUEUED_PREFILL_TOKENS_FAMILY
+    QUANTILE = 0.95
+    # Scale-down margin: shrink a pool only when the projected p95 at
+    # the smaller size stays under this fraction of the target, so the
+    # shrink itself cannot ride the projection error into a violation.
+    DOWNSCALE_MARGIN = 0.8
+
+    # The counter-window QPS machinery is pool-agnostic; borrow it
+    # verbatim instead of inheriting RequestRateAutoscaler's
+    # replica_policy preconditions (a disaggregated spec may be
+    # fixed-size).
+    record_request_count = RequestRateAutoscaler.record_request_count
+    current_qps_from_counter = \
+        RequestRateAutoscaler.current_qps_from_counter
+
+    def __init__(self, spec: ServiceSpec,
+                 decision_interval_seconds: float,
+                 qps_window_seconds: float = QPS_WINDOW_SECONDS) -> None:
+        super().__init__(spec, qps_window_seconds)
+        assert spec.disaggregation is not None
+        d = spec.disaggregation
+        from skypilot_tpu.serve import metrics_math
+        self._math = metrics_math
+        self._ttft_window = metrics_math.FederatedWindowedHistogram(
+            qps_window_seconds)
+        self._tpot_window = metrics_math.FederatedWindowedHistogram(
+            qps_window_seconds)
+        self._count_samples: Deque[Tuple[float, int]] = \
+            collections.deque()
+        up = max(1, int(math.ceil(spec.upscale_delay_seconds /
+                                  decision_interval_seconds)))
+        down = max(1, int(math.ceil(spec.downscale_delay_seconds /
+                                    decision_interval_seconds)))
+        self._pools = {
+            role: _PoolState(
+                d.min_for(role), d.max_for(role), up, down,
+                d.spot_headroom if d.use_spot(role) else 0)
+            for role in ('prefill', 'decode')
+        }
+        self.last_p95_ttft_ms: Optional[float] = None
+        self.last_p95_tpot_ms: Optional[float] = None
+        self.last_backlog_tokens: float = 0.0
+
+    def adopt_history(self, old: 'Autoscaler') -> None:
+        """Carry QPS samples, scrape windows, and per-pool targets
+        across a `serve update` rebuild."""
+        theirs = getattr(old, '_count_samples', None)
+        if theirs is not None:
+            self._count_samples.extend(theirs)
+        for attr in ('_ttft_window', '_tpot_window'):
+            window = getattr(old, attr, None)
+            if window is not None and hasattr(window, '_series'):
+                getattr(self, attr).adopt(window)
+        old_pools = getattr(old, '_pools', None)
+        if old_pools:
+            for role, state in self._pools.items():
+                if role in old_pools:
+                    state.adopt(old_pools[role])
+
+    def observe_exposition(self, exposition: str,
+                           now: Optional[float] = None) -> None:
+        samples = self._math.parse_samples(exposition)
+        self._ttft_window.record(
+            self._math.histogram_cumulative_by_series(
+                samples, self.TTFT_FAMILY), now)
+        self._tpot_window.record(
+            self._math.histogram_cumulative_by_series(
+                samples, self.TPOT_FAMILY), now)
+        self.last_backlog_tokens = self._math.gauge_total(
+            samples, self.BACKLOG_FAMILY)
+
+    def _pool_desired(self, state: _PoolState, live: int,
+                      p95_ms: Optional[float],
+                      target_ms: Optional[float],
+                      demand: int, extra_violation: bool) -> int:
+        """One pool's SLO-driven desired size (headroom excluded —
+        _PoolState.decision adds it)."""
+        live_sans_headroom = max(1, live - state.headroom)
+        if target_ms is not None and p95_ms is not None:
+            if p95_ms > target_ms or extra_violation:
+                # Violating at `live` replicas needs more than live.
+                return max(demand, state.target,
+                           live_sans_headroom) + 1
+            candidate = max(state.lo, demand, state.target - 1)
+            if candidate < state.target and \
+                    p95_ms * (live_sans_headroom / max(candidate, 1)) \
+                    <= target_ms * self.DOWNSCALE_MARGIN:
+                return candidate
+            return max(state.target, demand)
+        if extra_violation:
+            return max(demand, state.target, live_sans_headroom) + 1
+        # No latency signal: demand floor (decode) / base size.
+        return max(state.lo, demand)
+
+    def evaluate_pools(self, exposition: Optional[str],
+                       total_requests: int, live_prefill: int,
+                       live_decode: int,
+                       now: Optional[float] = None) -> PoolDecision:
+        now = time.time() if now is None else now
+        self.record_request_count(total_requests, now)
+        if exposition is not None:
+            self.observe_exposition(exposition, now)
+        else:
+            self.last_backlog_tokens = 0.0
+        ttft = self._ttft_window.quantile(self.QUANTILE, now)
+        tpot = self._tpot_window.quantile(self.QUANTILE, now)
+        self.last_p95_ttft_ms = ttft * 1e3 if ttft is not None else None
+        self.last_p95_tpot_ms = tpot * 1e3 if tpot is not None else None
+        qps_desired = 0
+        if self.spec.target_qps_per_replica:
+            qps_desired = int(math.ceil(
+                self.current_qps_from_counter() /
+                self.spec.target_qps_per_replica))
+        # Prefill pool: TTFT + prefill-token backlog (the LB sheds on
+        # the prefill pool's backlog, so over-limit backlog means
+        # demand is being suppressed there).
+        backlog_violation = (
+            self.spec.max_queue_tokens_per_replica is not None and
+            self.last_backlog_tokens >
+            self.spec.max_queue_tokens_per_replica *
+            max(live_prefill, 1))
+        prefill_state = self._pools['prefill']
+        prefill_state.commit(self._pool_desired(
+            prefill_state, live_prefill, self.last_p95_ttft_ms,
+            self.spec.target_ttft_ms, 0, backlog_violation))
+        # Decode pool: TPOT, with QPS demand as the floor — decode
+        # slots are what admitted requests occupy.
+        decode_state = self._pools['decode']
+        decode_state.commit(self._pool_desired(
+            decode_state, live_decode, self.last_p95_tpot_ms,
+            self.spec.target_tpot_ms, qps_desired, False))
+        return PoolDecision(
+            prefill=prefill_state.decision(live_prefill),
+            decode=decode_state.decision(live_decode))
+
+    def evaluate_scrape(self, exposition: Optional[str],
+                        total_requests: int, num_live_replicas: int,
+                        now: Optional[float] = None) -> AutoscalerDecision:
+        """Single-count compatibility shim (status paths): pools are
+        decided by evaluate_pools; the aggregate target is their sum."""
+        del exposition, total_requests, now
+        total = sum(
+            min(s.hi, s.target + s.headroom)
+            for s in self._pools.values())
+        return AutoscalerDecision(total, total - num_live_replicas)

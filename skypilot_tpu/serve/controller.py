@@ -154,6 +154,30 @@ class ServiceController:
             # dashboards read.
             exposition = (self._scrape_lb_metrics()
                           if self.autoscaler.wants_lb_scrape else None)
+            if self.autoscaler.is_pool_autoscaler:
+                # Disaggregated pools: one scrape, two independent
+                # decisions — TTFT sizes prefill, TPOT sizes decode.
+                pools = self.autoscaler.evaluate_pools(
+                    exposition, self.lb.proxied_requests(),
+                    self.manager.num_live('prefill'),
+                    self.manager.num_live('decode'), now)
+                for role, d in (('prefill', pools.prefill),
+                                ('decode', pools.decode)):
+                    if d.delta > 0:
+                        logger.info(
+                            f'Service {self.service_name!r}: scaling '
+                            f'{role} pool up by {d.delta} to '
+                            f'{d.target_num_replicas}{self._slo_note()}.')
+                        self.manager.scale_up(d.delta, role=role)
+                    elif d.delta < 0:
+                        logger.info(
+                            f'Service {self.service_name!r}: scaling '
+                            f'{role} pool down by {-d.delta} to '
+                            f'{d.target_num_replicas}{self._slo_note()}.')
+                        self.manager.scale_down(-d.delta, role=role)
+                self._update_service_status()
+                _shutdown.wait(_tick_interval())
+                continue
             decision = self.autoscaler.evaluate_scrape(
                 exposition, self.lb.proxied_requests(),
                 self.manager.num_live(), now)

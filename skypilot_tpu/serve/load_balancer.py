@@ -23,6 +23,21 @@ events with each ready replica's /debug view (the same pattern as the
 /metrics federation), so one query shows LB admission + routing + the
 engine's queue/prefill-chunk/first-token decomposition end to end.
 
+Disaggregated prefill/decode (pool-aware routing): replica managers
+stamp a role on every replica; when the ready set contains BOTH a
+prefill and a decode pool, proxied requests route into the PREFILL
+pool and the LB picks decode candidates from the decode pool's ready
+set (ranked by a second instance of the routing policy), stamping them
+on the forwarded request as `X-Skytpu-Decode-Url` — the prefill
+replica pushes the request's KV pages to the first candidate that
+accepts (inference/kv_transfer.py) and relays its completion.
+Prefill-backlog shedding consults only the prefill pool: decode
+replicas never queue prefill tokens, so counting them would fail the
+admission check open forever.  With either pool empty the LB degrades
+to routing over whatever is ready (every replica runs the full
+engine), so pool bring-up and preemption churn never 503 servable
+requests.
+
 Queue-aware admission control: the LB keeps a per-replica view of the
 engine's queued-prefill-token backlog — updated for free from the
 X-Skytpu-Queued-Prefill-Tokens header replicas attach to every proxied
@@ -84,6 +99,15 @@ _SHED_RETRY_AFTER_MAX_SECONDS = 60
 # at most this often, so draining queues re-open admission promptly
 # (waiting out the full staleness window would wedge-then-burst).
 _BACKLOG_REFRESH_INTERVAL_SECONDS = 1.0
+# The no-ready 503 Retry-After derives from the drain-rate EWMA (like
+# the 429 shed path) only while the last backlog observation is this
+# fresh — replica churn prunes the per-replica view, so this single
+# retained observation is all the 503 path has to reason from.
+_NO_READY_BACKLOG_MAX_AGE_SECONDS = 30.0
+# Decode candidates stamped per handoff: primary + one fallback — the
+# prefill replica re-routes the payload to the fallback when the
+# primary dies mid-push (no re-prefill).
+_DECODE_CANDIDATES = 2
 
 
 class LoadBalancer:
@@ -97,6 +121,8 @@ class LoadBalancer:
                  ) -> None:
         self.service_name = service_name
         self.port = port
+        # The policy setter also mints the decode-pool twin, so a
+        # `serve update` policy swap replaces both.
         self.policy = policy
         # Queue-aware shedding knob (service_spec
         # max_queue_tokens_per_replica; None = legacy behavior, shed
@@ -116,6 +142,13 @@ class LoadBalancer:
         # touched on the LB's own event loop (response path + federated
         # scrape), so no lock.
         self._backlog: dict = {}
+        # Latest single backlog observation, retained across ready-set
+        # pruning: the no-ready 503 path derives its Retry-After from
+        # it after the per-replica view is gone.
+        self._last_backlog_obs: Optional[Tuple[float, float]] = None
+        # url -> replica role ('prefill' / 'decode' / anything else =
+        # monolithic), from the ready-replicas view.
+        self._roles: dict = {}
         self._last_ready_set: frozenset = frozenset()
         # EWMA of observed backlog drain (tokens/sec across the
         # service), the basis of the shed Retry-After.
@@ -145,6 +178,20 @@ class LoadBalancer:
         # own event loop and closed in stop().
         self._session: Optional[aiohttp.ClientSession] = None
 
+    @property
+    def policy(self) -> LoadBalancingPolicy:
+        return self._policy
+
+    @policy.setter
+    def policy(self, policy: LoadBalancingPolicy) -> None:
+        """Install a routing policy plus its decode-pool twin: a
+        SECOND instance of the same class ranking KV-handoff decode
+        candidates, so decode-target picks track decode-pool load
+        without perturbing the prefill pool's rotation/outstanding
+        state."""
+        self._policy = policy
+        self._decode_policy = policy.clone()
+
     # ----- observability ------------------------------------------------------
     def proxied_requests(self) -> int:
         """Total requests proxied (including rejected 503s): the
@@ -158,10 +205,20 @@ class LoadBalancer:
         maps would otherwise grow for the LB's lifetime)."""
         if self._ready_replicas_fn is not None:
             pairs = self._ready_replicas_fn()
-            urls, labels = ([u for _, u in pairs],
-                            {u: str(r) for r, u in pairs})
+            urls, labels, roles = [], {}, {}
+            for pair in pairs:
+                # (replica_id, url) or (replica_id, url, role) — the
+                # role stamp arrived with disaggregated pools; plain
+                # services keep the 2-tuple shape.
+                rid, url = pair[0], pair[1]
+                urls.append(url)
+                labels[url] = str(rid)
+                if len(pair) > 2 and pair[2]:
+                    roles[url] = str(pair[2])
+            self._roles = roles
         else:
             urls, labels = self._ready_urls_fn(), {}
+            self._roles = {}
         current = frozenset(urls)
         if current != self._last_ready_set:
             self._last_ready_set = current
@@ -186,6 +243,7 @@ class LoadBalancer:
                     service=self.service_name,
                     replica=self._scrape_age_labels.pop(stale))
             self.policy.prune(current)
+            self._decode_policy.prune(current)
         return urls, labels
 
     # ----- queue-aware admission ----------------------------------------------
@@ -204,7 +262,9 @@ class LoadBalancer:
                     if self._drain_rate_tok_s is None \
                     else 0.3 * rate + 0.7 * self._drain_rate_tok_s
         self._backlog[url] = (max(0.0, tokens), now)
+        self._last_backlog_obs = (max(0.0, tokens), now)
         self.policy.update_load(url, tokens, now)
+        self._decode_policy.update_load(url, tokens, now)
 
     def _shed_excess_tokens(self, urls: List[str]) -> Optional[float]:
         """Tokens above the per-replica limit on the LEAST loaded
@@ -292,6 +352,39 @@ class LoadBalancer:
         return int(min(_SHED_RETRY_AFTER_MAX_SECONDS,
                        max(1, math.ceil(excess_tokens / rate))))
 
+    def _no_ready_retry_after(self) -> int:
+        """503 back-off, derived like the 429 shed path: how long the
+        last-known engine backlog takes to drain at the observed rate
+        — replicas mid-churn (NOT_READY blip, rolling update) come
+        back roughly when their queues clear, so this beats the static
+        constant whenever the EWMA is warm.  Falls back to the
+        constant when the EWMA or the retained backlog observation is
+        cold (fresh LB, long outage)."""
+        rate = self._drain_rate_tok_s
+        obs = self._last_backlog_obs
+        if rate is None or rate <= 0 or obs is None:
+            return _RETRY_AFTER_SECONDS
+        tokens, seen = obs
+        if time.monotonic() - seen > _NO_READY_BACKLOG_MAX_AGE_SECONDS \
+                or tokens <= 0:
+            return _RETRY_AFTER_SECONDS
+        return int(min(_SHED_RETRY_AFTER_MAX_SECONDS,
+                       max(1, math.ceil(tokens / rate))))
+
+    def _pick_decode_targets(self, decode_urls: List[str]) -> List[str]:
+        """Decode-pool candidates for one KV handoff: the decode
+        policy's pick first, then distinct fallbacks in ready order —
+        the prefill replica walks the list, so a dead primary costs
+        one bounded push attempt, not a re-prefill."""
+        primary = self._decode_policy.select(decode_urls)
+        targets = [primary] if primary else []
+        for u in decode_urls:
+            if len(targets) >= _DECODE_CANDIDATES:
+                break
+            if u not in targets:
+                targets.append(u)
+        return targets
+
     # ----- data plane ---------------------------------------------------------
     async def _handle(self, request: web.Request) -> web.StreamResponse:
         self._request_count += 1
@@ -305,7 +398,21 @@ class LoadBalancer:
                                service=self.service_name,
                                path=str(request.rel_url))
         urls, labels = self._ready()
-        excess = self._shed_excess_tokens(urls)
+        # Disaggregated pools: with both a prefill and a decode pool
+        # ready, traffic enters through the PREFILL pool and the LB
+        # names decode candidates for the KV handoff.  Admission
+        # control consults only the prefill pool's backlog — decode
+        # replicas never queue prefill tokens, and folding their
+        # always-zero gauges in would fail the every-replica-over-
+        # limit check open forever.
+        prefill_urls = [u for u in urls
+                        if self._roles.get(u) == 'prefill']
+        decode_urls = [u for u in urls
+                       if self._roles.get(u) == 'decode']
+        disagg = bool(prefill_urls) and bool(decode_urls)
+        route_urls = prefill_urls if disagg else urls
+        excess = self._shed_excess_tokens(
+            prefill_urls if prefill_urls else urls)
         if excess is not None:
             # Queue-aware shed: every ready replica's engine backlog is
             # at/over the limit — 429 now beats joining a queue that
@@ -331,7 +438,7 @@ class LoadBalancer:
                 status=429,
                 headers={'Retry-After': str(retry_after),
                          tracing.TRACE_HEADER: rid})
-        url = self.policy.select(urls)
+        url = self.policy.select(route_urls)
         if url is None:
             metrics_lib.inc_counter('skytpu_lb_no_ready_replicas_total',
                                     service=self.service_name)
@@ -342,12 +449,16 @@ class LoadBalancer:
             metrics_lib.inc_counter('skytpu_lb_requests_total',
                                     service=self.service_name,
                                     replica='none', code='503')
-            tracing.record_instant(rid, 'lb.no_ready_replicas')
+            retry_after = self._no_ready_retry_after()
+            tracing.record_instant(rid, 'lb.no_ready_replicas',
+                                   retry_after_s=retry_after)
             return web.json_response(
                 {'error': f'no ready replicas for {self.service_name}'},
                 status=503,
-                headers={'Retry-After': str(_RETRY_AFTER_SECONDS),
+                headers={'Retry-After': str(retry_after),
                          tracing.TRACE_HEADER: rid})
+        decode_targets = self._pick_decode_targets(decode_urls) \
+            if disagg else []
         target = url.rstrip('/') + '/' + str(request.rel_url).lstrip('/')
         replica = labels.get(url, url)
         # Routing decision + the per-replica signals it was made on
@@ -355,6 +466,9 @@ class LoadBalancer:
         obs = self._backlog.get(url)
         signals = {'backlog_tokens': obs[0] if obs is not None else None}
         signals.update(self.policy.snapshot(url))
+        if disagg:
+            signals['role'] = self._roles.get(url, 'prefill')
+            signals['decode_candidates'] = len(decode_targets)
         tracing.record_instant(
             rid, 'lb.route', replica=str(replica),
             ready_replicas=len(urls), **signals)
@@ -368,6 +482,14 @@ class LoadBalancer:
             # Propagate the trace id: the replica's engine spans key on
             # it, making the LB->replica trace one request's story.
             headers[tracing.TRACE_HEADER] = rid
+            if decode_targets:
+                # Disaggregation: name the decode candidates for the
+                # prefill replica's KV-page push (kv_transfer.py is
+                # jax-free, so importing its header constant here does
+                # not drag a device runtime into the LB).
+                from skypilot_tpu.inference.kv_transfer import (
+                    DECODE_URL_HEADER)
+                headers[DECODE_URL_HEADER] = ','.join(decode_targets)
             body = await request.read()
             assert self._session is not None
             async with self._session.request(

@@ -55,6 +55,13 @@ class LoadBalancingPolicy:
         del url
         return {}
 
+    def clone(self) -> 'LoadBalancingPolicy':
+        """Fresh instance of this policy class (no shared state).  The
+        load balancer ranks the DECODE pool's handoff candidates with
+        a clone of its routing policy, so decode-target picks see
+        decode-pool load without perturbing prefill-pool state."""
+        return type(self)()
+
     @staticmethod
     def make(name: str) -> 'LoadBalancingPolicy':
         impl = _POLICIES.get(name)

@@ -45,6 +45,9 @@ ENV_REPLICA_MAX_PROMPT = 'SKYTPU_SERVE_MAX_PROMPT_LEN'
 ENV_REPLICA_KV_PAGE = 'SKYTPU_SERVE_KV_PAGE_SIZE'
 ENV_REPLICA_KV_PAGES = 'SKYTPU_SERVE_KV_PAGES'
 ENV_REPLICA_PREFIX_CACHE = 'SKYTPU_SERVE_PREFIX_CACHE'
+# Disaggregated serving: the replica's pool role (prefill | decode),
+# read by the inference server as its --role default.
+ENV_REPLICA_ROLE = 'SKYTPU_SERVE_ROLE'
 
 
 class ReplicaManager:
@@ -78,15 +81,22 @@ class ReplicaManager:
         return f'serve-{self.service_name}-{replica_id}'
 
     # ----- scale up -----------------------------------------------------------
-    def _next_is_spot(self) -> bool:
+    def _next_is_spot(self, role: Optional[str] = None) -> bool:
         """Spot-or-on-demand for the next replica (reference: autoscaler
         ondemand fallback, sky/serve/autoscalers.py).
 
-        On-demand when: the task isn't spot at all; the first
+        Disaggregated pools decide per pool: the disaggregation
+        spec's use_spot_prefill/use_spot_decode flags drive placement
+        directly (ThunderServe's cost lever — decode replicas hold
+        only transferred KV, so their preemptions re-plan cheaply).
+
+        Otherwise on-demand when: the task isn't spot at all; the first
         base_ondemand_fallback_replicas slots aren't covered by live
         on-demand replicas; or dynamic_ondemand_fallback is on and every
         known zone has recently preempted us (spot capacity demonstrably
         gone — bridge on on-demand until it returns)."""
+        if role is not None and self.spec.disaggregation is not None:
+            return self.spec.disaggregation.use_spot(role)
         if not self.task.any_resources.use_spot:
             return False
         live = serve_state.get_replicas(self.service_name)
@@ -100,20 +110,40 @@ class ReplicaManager:
             return False
         return True
 
-    def scale_up(self, n: int) -> None:
+    def _next_role(self) -> Optional[str]:
+        """Pool for the next replica when the caller did not name one
+        (initial bring-up, rollout surge): fill the prefill pool to
+        its base size first — the LB cannot route disaggregated
+        traffic without it — then decode.  Counts only THIS version's
+        replicas: a rolling update surges a whole new generation, and
+        counting the draining generation's prefill replicas would
+        surge every new replica as decode, leaving the new generation
+        with no prefill pool at all once the old one drains."""
+        d = self.spec.disaggregation
+        if d is None:
+            return None
+        live = serve_state.get_replicas(self.service_name)
+        n_prefill = sum(1 for r in live
+                        if r.get('role') == 'prefill' and
+                        r['version'] >= self.version)
+        return 'prefill' if n_prefill < d.prefill_replicas else 'decode'
+
+    def scale_up(self, n: int, role: Optional[str] = None) -> None:
         for _ in range(n):
+            replica_role = role if role is not None else self._next_role()
             replica_id = serve_state.next_replica_id(self.service_name)
-            is_spot = self._next_is_spot()
+            is_spot = self._next_is_spot(replica_role)
             zone = None
             if is_spot and self.spot_placer is not None:
                 zone = self.spot_placer.select()
             serve_state.add_replica(
                 self.service_name, replica_id,
                 self._cluster_name(replica_id),
-                is_spot=is_spot, zone=zone, version=self.version)
+                is_spot=is_spot, zone=zone, version=self.version,
+                role=replica_role)
             th = threading.Thread(
                 target=self._launch_replica,
-                args=(replica_id, zone, is_spot),
+                args=(replica_id, zone, is_spot, replica_role),
                 name=f'serve-launch-{self.service_name}-{replica_id}',
                 daemon=True)
             with self._lock:
@@ -121,8 +151,8 @@ class ReplicaManager:
             th.start()
 
     def _replica_task(self, replica_id: int, port: int,
-                      zone: Optional[str],
-                      is_spot: bool) -> task_lib.Task:
+                      zone: Optional[str], is_spot: bool,
+                      role: Optional[str] = None) -> task_lib.Task:
         task = task_lib.Task.from_yaml_config(self.task.to_yaml_config())
         task.service = None  # the replica runs the workload, not a service
         envs = {
@@ -130,6 +160,11 @@ class ReplicaManager:
             ENV_REPLICA_ID: str(replica_id),
             ENV_SERVICE_NAME: self.service_name,
         }
+        if role is not None:
+            # Disaggregated pool role: the inference server reads this
+            # as its --role default (prefill replicas push KV pages,
+            # decode replicas accept /v1/kv_adopt).
+            envs[ENV_REPLICA_ROLE] = role
         if self.spec.tensor_parallel > 1:
             # The inference server reads this as its --tensor default:
             # the replica's engine shards over that many chips.
@@ -172,11 +207,13 @@ class ReplicaManager:
         return 8080
 
     def _launch_replica(self, replica_id: int, zone: Optional[str],
-                        is_spot: bool) -> None:
+                        is_spot: bool,
+                        role: Optional[str] = None) -> None:
         cluster = self._cluster_name(replica_id)
         port = self._pick_port()
         try:
-            task = self._replica_task(replica_id, port, zone, is_spot)
+            task = self._replica_task(replica_id, port, zone, is_spot,
+                                      role)
             job_id, handle = execution.launch(
                 task, cluster, detach_run=True, quiet_optimizer=True,
                 policy_operation='serve')
@@ -209,10 +246,15 @@ class ReplicaManager:
                 self.spot_placer.handle_termination(zone)
 
     # ----- scale down / terminate ---------------------------------------------
-    def scale_down(self, n: int) -> None:
+    def scale_down(self, n: int, role: Optional[str] = None) -> None:
         """Terminate n replicas, least-useful first: non-ready before
-        ready, then newest first (reference scales down newest)."""
+        ready, then newest first (reference scales down newest).
+        `role` restricts the cut to one disaggregated pool — the
+        per-pool autoscaler shrinks decode without touching
+        prefill and vice versa."""
         replicas = serve_state.get_replicas(self.service_name)
+        if role is not None:
+            replicas = [r for r in replicas if r.get('role') == role]
         order = sorted(
             replicas,
             key=lambda r: (r['status'] is ReplicaStatus.READY,
@@ -423,18 +465,21 @@ class ReplicaManager:
 
     # ----- views --------------------------------------------------------------
     def ready_urls(self) -> List[str]:
-        return [url for _, url in self.ready_replicas()]
+        return [url for _, url, _ in self.ready_replicas()]
 
-    def ready_replicas(self) -> List[Tuple[int, str]]:
-        """(replica_id, url) pairs for READY replicas — the LB labels
-        per-replica metric series and federates /metrics from these."""
+    def ready_replicas(self) -> List[Tuple[int, str, Optional[str]]]:
+        """(replica_id, url, role) triples for READY replicas — the LB
+        labels per-replica metric series, federates /metrics, and
+        splits disaggregated pools from these (role None =
+        monolithic)."""
         return [
-            (r['replica_id'], r['url'])
+            (r['replica_id'], r['url'], r.get('role'))
             for r in serve_state.get_replicas(self.service_name)
             if r['status'] is ReplicaStatus.READY and r['url']
         ]
 
-    def num_live(self) -> int:
+    def num_live(self, role: Optional[str] = None) -> int:
         return sum(
             1 for r in serve_state.get_replicas(self.service_name)
-            if r['status'].counts_toward_target())
+            if r['status'].counts_toward_target() and
+            (role is None or r.get('role') == role))

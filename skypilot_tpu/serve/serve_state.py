@@ -79,6 +79,10 @@ _DDL = [
     # turn READY (parity: sky/serve service versions).
     'ALTER TABLE services ADD COLUMN version INTEGER DEFAULT 1',
     'ALTER TABLE replicas ADD COLUMN version INTEGER DEFAULT 1',
+    # Disaggregated prefill/decode pools: each replica records the role
+    # it was launched for (NULL = monolithic), so the LB's pool-aware
+    # routing and the per-pool autoscaler survive controller restarts.
+    'ALTER TABLE replicas ADD COLUMN role TEXT',
 ]
 
 
@@ -187,14 +191,14 @@ def next_replica_id(service_name: str) -> int:
 
 def add_replica(service_name: str, replica_id: int, cluster_name: str,
                 is_spot: bool = False, zone: Optional[str] = None,
-                version: int = 1) -> None:
+                version: int = 1, role: Optional[str] = None) -> None:
     db_utils.execute(
         _ensure(), 'INSERT OR REPLACE INTO replicas (replica_id, '
         'service_name, cluster_name, status, is_spot, zone, launched_at, '
-        'version) VALUES (?,?,?,?,?,?,?,?)',
+        'version, role) VALUES (?,?,?,?,?,?,?,?,?)',
         (replica_id, service_name, cluster_name,
          ReplicaStatus.PROVISIONING.value, int(is_spot), zone,
-         time.time(), version))
+         time.time(), version, role))
 
 
 def set_replica_status(service_name: str, replica_id: int,
@@ -270,4 +274,5 @@ def _replica_row(row) -> Dict[str, Any]:
         'zone': row['zone'],
         'launched_at': row['launched_at'],
         'version': int(row['version'] or 1),
+        'role': row['role'] if 'role' in row.keys() else None,
     }

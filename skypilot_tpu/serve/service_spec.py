@@ -24,6 +24,37 @@ class ReadinessProbe:
 
 
 @dataclasses.dataclass(frozen=True)
+class DisaggregationSpec:
+    """Disaggregated prefill/decode pools (requires kv_page_size —
+    pages are the KV-transfer unit).  Base sizes are the pools'
+    floors; the *_max knobs open independent autoscaling per pool
+    (TTFT violations size prefill, TPOT violations size decode).  Spot
+    placement is per pool; a spot pool holds `spot_headroom` replicas
+    above its SLO-driven target so one preemption degrades headroom
+    instead of breaching the SLO while the re-plan provisions."""
+    prefill_replicas: int = 1
+    decode_replicas: int = 1
+    prefill_max_replicas: Optional[int] = None
+    decode_max_replicas: Optional[int] = None
+    use_spot_prefill: bool = False
+    use_spot_decode: bool = False
+    spot_headroom: int = 1
+
+    def min_for(self, role: str) -> int:
+        return (self.prefill_replicas if role == 'prefill'
+                else self.decode_replicas)
+
+    def max_for(self, role: str) -> int:
+        cap = (self.prefill_max_replicas if role == 'prefill'
+               else self.decode_max_replicas)
+        return cap if cap is not None else self.min_for(role)
+
+    def use_spot(self, role: str) -> bool:
+        return (self.use_spot_prefill if role == 'prefill'
+                else self.use_spot_decode)
+
+
+@dataclasses.dataclass(frozen=True)
 class ServiceSpec:
     """Validated, immutable service configuration."""
     readiness_probe: ReadinessProbe
@@ -78,6 +109,12 @@ class ServiceSpec:
     # this, BEFORE the replicas saturate.  None disables shedding
     # (legacy behavior: reject only at zero ready replicas).
     max_queue_tokens_per_replica: Optional[int] = None
+    # Disaggregated prefill/decode pools (None = monolithic replicas,
+    # byte-identical legacy behavior).  Replicas launch with a role
+    # (SKYTPU_SERVE_ROLE), the LB routes through the prefill pool and
+    # hands prefilled KV pages to the decode pool, and the autoscaler
+    # sizes the two pools independently.
+    disaggregation: Optional[DisaggregationSpec] = None
 
     @classmethod
     def from_yaml_config(cls, config: Dict[str, Any]) -> 'ServiceSpec':
@@ -125,6 +162,39 @@ class ServiceSpec:
                 'service.max_queue_tokens_per_replica must be positive '
                 f'(got {max_queue_tokens}) — a zero limit sheds every '
                 'request')
+        disagg_raw = config.get('disaggregation')
+        disaggregation = None
+        if disagg_raw is not None:
+            if kv_page_size is None:
+                raise exceptions.InvalidTaskError(
+                    'service.disaggregation requires service.'
+                    'kv_page_size — KV pages are the prefill->decode '
+                    'transfer unit')
+            disaggregation = DisaggregationSpec(
+                prefill_replicas=int(disagg_raw['prefill_replicas']),
+                decode_replicas=int(disagg_raw['decode_replicas']),
+                prefill_max_replicas=(
+                    int(disagg_raw['prefill_max_replicas'])
+                    if disagg_raw.get('prefill_max_replicas') is not None
+                    else None),
+                decode_max_replicas=(
+                    int(disagg_raw['decode_max_replicas'])
+                    if disagg_raw.get('decode_max_replicas') is not None
+                    else None),
+                use_spot_prefill=bool(
+                    disagg_raw.get('use_spot_prefill', False)),
+                use_spot_decode=bool(
+                    disagg_raw.get('use_spot_decode', False)),
+                spot_headroom=int(disagg_raw.get('spot_headroom', 1)),
+            )
+            for role in ('prefill', 'decode'):
+                if disaggregation.max_for(role) < \
+                        disaggregation.min_for(role):
+                    raise exceptions.InvalidTaskError(
+                        f'service.disaggregation: {role}_max_replicas '
+                        f'({disaggregation.max_for(role)}) < '
+                        f'{role}_replicas '
+                        f'({disaggregation.min_for(role)})')
         if policy is None:
             n = int(fixed if fixed is not None else 1)
             return cls(readiness_probe=probe, min_replicas=n,
@@ -136,7 +206,8 @@ class ServiceSpec:
                        kv_page_size=kv_page_size,
                        kv_pages=kv_pages,
                        prefix_cache=prefix_cache,
-                       max_queue_tokens_per_replica=max_queue_tokens)
+                       max_queue_tokens_per_replica=max_queue_tokens,
+                       disaggregation=disaggregation)
         min_r = int(policy.get('min_replicas', 1))
         max_r = policy.get('max_replicas')
         target_qps = policy.get('target_qps_per_replica')
@@ -197,6 +268,7 @@ class ServiceSpec:
             target_tpot_ms=(float(target_tpot)
                             if target_tpot is not None else None),
             max_queue_tokens_per_replica=max_queue_tokens,
+            disaggregation=disaggregation,
         )
 
     def to_yaml_config(self) -> Dict[str, Any]:
@@ -246,6 +318,23 @@ class ServiceSpec:
         if self.max_queue_tokens_per_replica is not None:
             out['max_queue_tokens_per_replica'] = \
                 self.max_queue_tokens_per_replica
+        if self.disaggregation is not None:
+            d = self.disaggregation
+            block: Dict[str, Any] = {
+                'prefill_replicas': d.prefill_replicas,
+                'decode_replicas': d.decode_replicas,
+            }
+            if d.prefill_max_replicas is not None:
+                block['prefill_max_replicas'] = d.prefill_max_replicas
+            if d.decode_max_replicas is not None:
+                block['decode_max_replicas'] = d.decode_max_replicas
+            if d.use_spot_prefill:
+                block['use_spot_prefill'] = True
+            if d.use_spot_decode:
+                block['use_spot_decode'] = True
+            if d.spot_headroom != 1:
+                block['spot_headroom'] = d.spot_headroom
+            out['disaggregation'] = block
         return out
 
     @property

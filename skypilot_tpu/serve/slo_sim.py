@@ -176,3 +176,205 @@ def requests_weighted_p95(history: List[Tuple[float, int, float]],
         if acc >= rank:
             return tpot_ms
     return expanded[-1][0]
+
+
+# ----- disaggregated prefill/decode (phase-cost model) ------------------------
+# VirtualService above models one homogeneous pool with a single
+# latency knee.  The classes below split the model into the two PHASES
+# a replica actually runs — compute-bound prefill and bandwidth-bound
+# decode — so the sim can drive MIXED pools (ThunderServe,
+# arXiv:2502.09334) and expose the coupling disaggregation removes:
+# on a monolithic replica the phases share the device, so each phase
+# sees only the device-time fraction the other leaves behind (the
+# chunked-prefill interleave bounds the stall to one chunk, but the
+# *throughput* steal remains); on split pools each phase gets a whole
+# replica.
+
+import dataclasses as _dataclasses
+
+
+@_dataclasses.dataclass(frozen=True)
+class PhaseCosts:
+    """Per-replica phase costs for the disaggregated sim.
+
+    prefill_tok_per_s is the replica's compute-bound prefill
+    throughput; decode_tok_per_s its bandwidth-bound aggregate decode
+    throughput (slots x 1/TPOT at the knee).  handoff_s is the KV-page
+    push cost (serialize + RPC + adopt scatter), paid once per request
+    on the disaggregated TTFT path only."""
+    base_ttft_s: float = 0.05
+    base_tpot_s: float = 0.010
+    prefill_tok_per_s: float = 20000.0
+    decode_tok_per_s: float = 2500.0
+    handoff_s: float = 0.015
+
+
+def phase_latency(base_s: float, own_share: float,
+                  other_share: float) -> float:
+    """Latency of one phase on a replica whose device time is shared.
+
+    `own_share` / `other_share` are offered device-time fractions
+    (demand / capacity).  The phase runs in the time the OTHER phase
+    leaves (processor sharing — this is the cross-phase coupling), and
+    queueing delay grows load-proportionally once its own effective
+    utilization passes 1 (same shape as VirtualService's knee).  With
+    other_share == 0 this reduces to base * max(1, own_share): a
+    dedicated pool."""
+    avail = max(0.05, 1.0 - min(other_share, 0.95))
+    util = own_share / avail
+    return (base_s / avail) * max(1.0, util)
+
+
+class MixedPoolService(VirtualService):
+    """Virtual service with separate prefill/decode phase costs.
+
+    `step_monolithic` runs both phases colocated on one pool;
+    `step_pools` runs them disaggregated on (prefill_replicas,
+    decode_replicas).  Both record into the same cumulative TTFT/TPOT
+    histograms VirtualService exposes, so the exposition() text drives
+    the real autoscalers end to end."""
+
+    def __init__(self, costs: PhaseCosts, prompt_tokens: float,
+                 new_tokens: float) -> None:
+        super().__init__(base_tpot_s=costs.base_tpot_s,
+                         base_ttft_s=costs.base_ttft_s)
+        self.costs = costs
+        self.prompt_tokens = prompt_tokens
+        self.new_tokens = new_tokens
+
+    def _shares(self, qps: float, replicas: int):
+        per = qps / max(replicas, 1)
+        prefill = per * self.prompt_tokens / self.costs.prefill_tok_per_s
+        decode = per * self.new_tokens / self.costs.decode_tok_per_s
+        return prefill, decode
+
+    def latencies_monolithic(self, qps: float, replicas: int):
+        """(ttft_s, tpot_s) with both phases colocated: each phase
+        sees the device-time fraction the other leaves behind."""
+        p, d = self._shares(qps, replicas)
+        ttft = phase_latency(self.costs.base_ttft_s, p, d)
+        tpot = phase_latency(self.costs.base_tpot_s, d, p)
+        return ttft, tpot
+
+    def latencies_pools(self, qps: float, prefill_replicas: int,
+                        decode_replicas: int):
+        """(ttft_s, tpot_s) with dedicated pools: no cross-phase
+        steal; TTFT pays the KV handoff once."""
+        p, _ = self._shares(qps, max(prefill_replicas, 1))
+        _, d = self._shares(qps, max(decode_replicas, 1))
+        ttft = phase_latency(self.costs.base_ttft_s, p, 0.0) + \
+            self.costs.handoff_s
+        tpot = phase_latency(self.costs.base_tpot_s, d, 0.0)
+        return ttft, tpot
+
+    def _record(self, qps: float, dt_s: float, ttft: float,
+                tpot: float):
+        n = qps * dt_s
+        self._observe(TPOT_FAMILY, tpot, n)
+        self._observe(TTFT_FAMILY, ttft, n)
+        self.total_requests += int(round(n))
+        return ttft, tpot
+
+    def step_monolithic(self, qps: float, replicas: int, dt_s: float):
+        return self._record(qps, dt_s,
+                            *self.latencies_monolithic(qps, replicas))
+
+    def step_pools(self, qps: float, prefill_replicas: int,
+                   decode_replicas: int, dt_s: float):
+        return self._record(
+            qps, dt_s,
+            *self.latencies_pools(qps, prefill_replicas,
+                                  decode_replicas))
+
+
+# The canonical disaggregation scenario, shared by bench.py's
+# bench_disagg and its test twin (tests/test_serve_disagg.py) so the
+# README's pinned numbers and the asserting tests provably describe
+# the SAME experiment.  Saturated mixed long/short traffic: the
+# prompt-token mean models 70% short (256-token) / 30% long
+# (~4100-token) requests — heavy enough prefill that a monolithic
+# pool's cross-phase steal breaks the TPOT SLO at the plateau, while
+# an equal-chip split pool holds both targets.
+DISAGG_COSTS = PhaseCosts(base_ttft_s=0.05, base_tpot_s=0.010,
+                          prefill_tok_per_s=20000.0,
+                          decode_tok_per_s=1030.0, handoff_s=0.015)
+DISAGG_PROMPT_TOKENS = 1408.0
+DISAGG_NEW_TOKENS = 128.0
+DISAGG_TARGET_TTFT_MS = 120.0
+DISAGG_TARGET_TPOT_MS = 12.0
+DISAGG_TOTAL_CHIPS = 8
+DISAGG_PEAK_QPS = 40.0
+DISAGG_TICK_S = 10.0
+
+
+def disagg_ramp(plateau_ticks: int = 8) -> List[float]:
+    return [5.0, 10.0, 15.0, 20.0, 25.0, 30.0, 35.0] + \
+        [DISAGG_PEAK_QPS] * plateau_ticks
+
+
+def make_disagg_service() -> MixedPoolService:
+    return MixedPoolService(DISAGG_COSTS, DISAGG_PROMPT_TOKENS,
+                            DISAGG_NEW_TOKENS)
+
+
+def make_disagg_autoscaler(spot_headroom: int = 1,
+                           tick_s: float = DISAGG_TICK_S):
+    """The canonical per-pool autoscaler: prefill pool fixed-size 2
+    (TTFT never violates there), decode pool driven by the QPS demand
+    floor (claimed 8 qps/replica) + TPOT violations, on spot with the
+    given preemption headroom."""
+    from skypilot_tpu.serve.autoscalers import Autoscaler
+    from skypilot_tpu.serve.service_spec import ServiceSpec
+    spec = ServiceSpec.from_yaml_config({
+        'readiness_probe': '/health',
+        'kv_page_size': 64,
+        'replica_policy': {
+            'min_replicas': 1,
+            'max_replicas': DISAGG_TOTAL_CHIPS,
+            'target_qps_per_replica': 8.0,
+            'target_ttft_ms': DISAGG_TARGET_TTFT_MS,
+            'target_tpot_ms': DISAGG_TARGET_TPOT_MS,
+            'upscale_delay_seconds': tick_s,
+            'downscale_delay_seconds': 1200.0,
+        },
+        'disaggregation': {
+            'prefill_replicas': 2,
+            'decode_replicas': 1,
+            'prefill_max_replicas': DISAGG_TOTAL_CHIPS,
+            'decode_max_replicas': DISAGG_TOTAL_CHIPS,
+            'use_spot_decode': True,
+            'spot_headroom': spot_headroom,
+        },
+    })
+    return Autoscaler.make(spec, decision_interval_seconds=tick_s)
+
+
+def run_disagg_ramp(autoscaler, service: MixedPoolService,
+                    qps_schedule: List[float],
+                    preempt_tick: Optional[int] = None,
+                    tick_s: float = DISAGG_TICK_S,
+                    now0: float = 1_000.0):
+    """Drive the per-pool autoscaler through a ramp with ideal
+    provisioning (run_ramp's disaggregated twin).  At `preempt_tick`
+    one decode replica is preempted BEFORE traffic flows — that tick
+    runs on the reduced pool, and the autoscaler's next decision is
+    the lightweight re-plan that restores it.  Returns
+    [(qps, prefill_replicas, decode_replicas, ttft_ms, tpot_ms)]."""
+    history = []
+    live_p = autoscaler.spec.disaggregation.prefill_replicas
+    live_d = (autoscaler.spec.disaggregation.decode_replicas +
+              (autoscaler.spec.disaggregation.spot_headroom
+               if autoscaler.spec.disaggregation.use_spot_decode else 0))
+    now = now0
+    for i, qps in enumerate(qps_schedule):
+        if preempt_tick is not None and i == preempt_tick:
+            live_d = max(1, live_d - 1)
+        ttft, tpot = service.step_pools(qps, live_p, live_d, tick_s)
+        history.append((qps, live_p, live_d, ttft * 1e3, tpot * 1e3))
+        decision = autoscaler.evaluate_pools(
+            service.exposition(), service.total_requests, live_p,
+            live_d, now)
+        live_p = decision.prefill.target_num_replicas
+        live_d = decision.decode.target_num_replicas
+        now += tick_s
+    return history

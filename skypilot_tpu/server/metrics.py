@@ -87,6 +87,13 @@ _HELP = {
         'engine\'s real admission headroom',
     'skytpu_engine_requests_total':
         'Requests admitted to the engine queue',
+    'skytpu_engine_kv_exports_total':
+        'Prefill-role requests whose KV pages were gathered for '
+        'handoff to a decode replica (disaggregated serving)',
+    'skytpu_engine_kv_adopts_total':
+        'KV handoffs adopted into this engine\'s page pool (decode '
+        'role): pages scattered at page granularity, decode continued '
+        'from the transferred first token — no per-token recompute',
     'skytpu_engine_batch_occupancy_ratio':
         'Active decode slots / total slots, sampled each loop step',
     'skytpu_engine_active_slots': 'Decode slots occupied this step',
@@ -106,6 +113,18 @@ _HELP = {
         'Age of the last successful federated /metrics scrape of each '
         'replica — the staleness of the window SLO decisions run on '
         '(a growing age means that replica is scraping dark)',
+    # ----- disaggregated prefill/decode (KV handoff) ----------------------
+    'skytpu_lb_kv_transfer_total':
+        'KV-page handoff pushes from prefill to decode replicas, by '
+        'outcome (ok / error — an errored push fails over to the next '
+        'decode candidate, then to monolithic serving)',
+    'skytpu_lb_kv_transfer_bytes_total':
+        'Payload bytes of successful KV-page handoffs (header + '
+        'layer-major page data)',
+    'skytpu_lb_kv_transfer_seconds':
+        'Wall time of one KV handoff push attempt, including the '
+        'decode replica\'s generation (the adopt response carries the '
+        'completion)',
     # ----- training -------------------------------------------------------
     'skytpu_train_step_seconds': 'Train step wall time',
     'skytpu_train_tokens_per_second':

@@ -104,6 +104,14 @@ SPAN_HELP = {
         'size) and the measured TTFT',
     'engine.stream_end':
         'Request retired: emitted token count and decode duration',
+    'engine.kv_export':
+        'Prefill-role retire gathered this request\'s KV pages off '
+        'the pool for handoff to a decode replica (dispatch only; the '
+        'device->host copy happens on the HTTP thread)',
+    'engine.kv_adopt':
+        'Decode-role admission scattered a KV handoff\'s pages into '
+        'the local pool and seeded the slot from the transferred '
+        'first token — occupies the prefill slot of the TTFT tiling',
     # ----- managed jobs (postmortem events) --------------------------------
     'jobs.preemption':
         'Managed job cluster lost to preemption (cloud says not-UP)',
@@ -262,9 +270,12 @@ def decompose(events: List[dict]) -> dict:
     queue = sum(durs('engine.queue_wait'))
     chunks = durs('engine.prefill_chunk')
     # A prefix-cache hit's page gather replaces the prefill work it
-    # skipped: its span occupies the same slot in the tiling.
+    # skipped (its span occupies the same slot in the tiling), and an
+    # adopted KV handoff's scatter replaces the prefill entirely.
     hits = durs('engine.prefix_hit')
-    prefill = sum(durs('engine.prefill')) + sum(chunks) + sum(hits)
+    adopts = durs('engine.kv_adopt')
+    prefill = (sum(durs('engine.prefill')) + sum(chunks) + sum(hits) +
+               sum(adopts))
     dispatch = sum(durs('engine.dispatch'))
     cached_tokens = sum(
         e['attrs'].get('cached_tokens') or 0 for e in events

@@ -120,6 +120,37 @@ _SERVICE_SCHEMA: Dict[str, Any] = {
         # queue that already violates the SLO.  A zero limit would shed
         # everything — minimum 1.
         'max_queue_tokens_per_replica': {'type': 'integer', 'minimum': 1},
+        # Disaggregated prefill/decode serving (requires kv_page_size —
+        # pages are the KV-transfer unit): split the replicas into a
+        # prefill pool and a decode pool; the LB routes requests into
+        # the prefill pool and the prefilled KV pages are handed off
+        # to a decode replica.  With SLO targets set, the two pools
+        # scale INDEPENDENTLY: TTFT violations size the prefill pool,
+        # TPOT violations the decode pool.
+        'disaggregation': {
+            'type': 'object',
+            'additionalProperties': False,
+            'required': ['prefill_replicas', 'decode_replicas'],
+            'properties': {
+                'prefill_replicas': {'type': 'integer', 'minimum': 1},
+                'decode_replicas': {'type': 'integer', 'minimum': 1},
+                # Autoscaling ceilings per pool; omitted = the pool is
+                # fixed at its base size.
+                'prefill_max_replicas': {'type': 'integer',
+                                         'minimum': 1},
+                'decode_max_replicas': {'type': 'integer',
+                                        'minimum': 1},
+                # Spot placement per pool (ThunderServe's cost lever:
+                # decode replicas hold only transferred KV + their own
+                # generations, so a preemption re-plans cheaply).
+                'use_spot_prefill': {'type': 'boolean'},
+                'use_spot_decode': {'type': 'boolean'},
+                # Extra replicas a SPOT pool holds above its SLO-driven
+                # target, so one preemption degrades headroom instead
+                # of breaching the SLO while the re-plan provisions.
+                'spot_headroom': {'type': 'integer', 'minimum': 0},
+            },
+        },
     },
 }
 

@@ -1,0 +1,25 @@
+"""Known-bad twin of inference/kv_transfer.py for the unbounded-io
+rule: KV-handoff HTTP pushes MUST carry a timeout (a wedged decode
+replica would otherwise hold the prefill request — and its exported
+pages — forever), and handoff retry loops must pace or deadline.
+PARSED by tests/test_static_analysis.py, never imported."""
+
+
+async def push_without_timeout(session, url, payload):
+    # BAD: no timeout= — a dead decode replica hangs the handoff (and
+    # the client's request) forever.
+    async with session.post(url + '/v1/kv_adopt',
+                            data=payload) as resp:
+        return await resp.json()
+
+
+def hot_retry_push(session, urls, payload):
+    # BAD: while-True retry over candidates with no sleep/backoff and
+    # no deadline — a dead decode pool turns into a hot spin.
+    i = 0
+    while True:
+        resp = session.post(urls[i % len(urls)], data=payload,
+                            timeout=5)
+        if resp.status == 200:
+            return resp
+        i += 1

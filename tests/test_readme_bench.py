@@ -191,3 +191,48 @@ def test_readme_makes_no_unmeasured_slo_ramp_claim():
         assert all(f == want for f in found), (
             f'README SLO-ramp claim {found} drifted from {path}: '
             f'expected {want}')
+
+
+def test_readme_disagg_claims_pinned():
+    """Disaggregated-serving claims are mechanical, both directions:
+    once an artifact carries serve.disagg, the measured mixed pool
+    must beat the homogeneous pool on $/SLO-met at equal chips, the
+    injected decode-pool preemption must NOT breach the TPOT SLO
+    (while the no-headroom counterfactual MUST), and the README's
+    numeric claim must match the artifact; before an artifact carries
+    it, the README may not invent the numbers."""
+    path, parsed = _latest_bench()
+    disagg = (parsed['detail'].get('serve') or {}).get('disagg')
+    with open(os.path.join(_ROOT, 'README.md'), encoding='utf-8') as f:
+        readme = ' '.join(f.read().split())
+    found = re.findall(
+        r'\$([0-9.]+)/1k SLO-met \(disagg[^)]*\) vs '
+        r'\$([0-9.]+)/1k \(monolithic\)', readme)
+    if not disagg or disagg.get('usd_per_1k_slo_met_disagg') is None:
+        assert not found, (
+            f'README claims a disaggregation result ({found}) but the '
+            f'latest bench artifact {path} has no serve.disagg '
+            f'scenario')
+        return
+    mono = disagg.get('usd_per_1k_slo_met_monolithic')
+    # The acceptance criteria, held mechanically on the artifact:
+    assert mono is None or \
+        disagg['usd_per_1k_slo_met_disagg'] < mono, (
+            f'{path}: mixed pool must undercut the homogeneous pool '
+            f'on $/SLO-met at equal chips')
+    assert disagg['slo_met_frac_disagg'] > \
+        disagg['slo_met_frac_monolithic'], path
+    assert disagg['preemption_tpot_ok'] is True, (
+        f'{path}: a decode-pool preemption mid-ramp breached the '
+        f'TPOT SLO')
+    assert disagg['no_headroom_preemption_breaches'] is True, (
+        f'{path}: the no-headroom counterfactual should breach — '
+        f'otherwise the spot headroom is dead weight')
+    want = (f"{disagg['usd_per_1k_slo_met_disagg']:.3f}",
+            f"{mono:.3f}" if mono is not None else None)
+    assert found, (
+        f'{path} carries serve.disagg but README.md makes no '
+        f'"$X/1k SLO-met (disagg ...) vs $Y/1k (monolithic)" claim')
+    assert all(f == want for f in found), (
+        f'README disaggregation claim {found} drifted from {path}: '
+        f'expected {want}')

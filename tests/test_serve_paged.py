@@ -558,3 +558,61 @@ def test_http_server_serves_with_paging(params):
     finally:
         engine.stop()
     assert engine.healthy
+
+
+# ----- allocator property test (guards the handoff adopt/release path) --------
+def test_page_pool_randomized_property():
+    """Randomized alloc/ref/release sequences against a model of the
+    ownership rules never violate check_conserved(), and releasing
+    everything always recovers the FULL pool — the invariant the
+    KV-transfer adopt/release choreography (disaggregated serving)
+    leans on: an adopted request's pages must be indistinguishable
+    from locally allocated ones to the allocator."""
+    rng = np.random.default_rng(1234)
+    for trial in range(20):
+        n_pages = int(rng.integers(3, 24))
+        pool = PagePool(n_pages, 8)
+        # Model state: page -> refcount we believe it has.
+        held = {}                      # page -> refs held by "slots"
+        for _ in range(300):
+            op = rng.integers(0, 3)
+            if op == 0:                # alloc
+                want = int(rng.integers(1, n_pages))
+                got = pool.alloc(want)
+                if want > (n_pages - 1) - sum(
+                        1 for p in held if held[p] > 0):
+                    # More than can ever be free: must refuse whole.
+                    if got is not None:
+                        for p in got:
+                            held[p] = held.get(p, 0) + 1
+                else:
+                    if got is not None:
+                        assert len(got) == len(set(got)) == want
+                        for p in got:
+                            assert held.get(p, 0) == 0, 'page reused live'
+                            held[p] = 1
+            elif op == 1 and held:     # ref a live page (prefix share)
+                live = [p for p, c in held.items() if c > 0]
+                if live:
+                    p = int(rng.choice(live))
+                    pool.ref([p])
+                    held[p] += 1
+            elif op == 2 and held:     # release one reference
+                live = [p for p, c in held.items() if c > 0]
+                if live:
+                    p = int(rng.choice(live))
+                    pool.release([p])
+                    held[p] -= 1
+            pool.check_conserved()
+            for p, c in held.items():
+                assert pool.refcount(p) == c, (trial, p)
+        # Full release recovers the whole pool.
+        for p, c in list(held.items()):
+            if c > 0:
+                pool.release([p] * c)
+        pool.check_conserved()
+        assert pool.free_pages == n_pages - 1
+        got = pool.alloc(n_pages - 1)
+        assert got is not None and len(got) == n_pages - 1
+        pool.release(got)
+        pool.check_conserved()

@@ -230,3 +230,64 @@ def test_shed_path_self_refreshes_backlog_and_readmits():
     finally:
         lb.stop()
         stop_replica()
+
+
+def test_no_ready_503_retry_after_derived_from_drain_rate():
+    """Satellite contract: the no-ready 503's Retry-After derives from
+    the drain-rate EWMA like the 429 shed path (cold EWMA falls back
+    to the static constant).  The LB learns backlog + drain rate from
+    response headers, then the ready set empties: the 503 should tell
+    clients to come back when the last-known backlog has drained, not
+    always "5"."""
+    from skypilot_tpu.serve.load_balancer import (LoadBalancer,
+                                                  _RETRY_AFTER_SECONDS)
+    from skypilot_tpu.serve.load_balancing_policies import RoundRobinPolicy
+
+    # Cold LB: no observations -> the static constant.
+    cold = LoadBalancer('cold-svc', _free_port(), RoundRobinPolicy(),
+                        ready_urls_fn=lambda: [])
+    cold.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(cold.endpoint + '/work')
+        assert err.value.code == 503
+        assert int(err.value.headers['Retry-After']) == \
+            _RETRY_AFTER_SECONDS
+    finally:
+        cold.stop()
+
+    # Warm EWMA: two decreasing backlog observations teach the drain
+    # rate; the replica then drops out of the ready set.
+    state = {'backlog': 4000.0}
+    port, stop_replica = _run_app_on_thread(_fake_replica(state))
+    url = f'http://127.0.0.1:{port}'
+    ready = [url]
+    lb = LoadBalancer('warm-svc', _free_port(), RoundRobinPolicy(),
+                      ready_urls_fn=lambda: list(ready),
+                      ready_replicas_fn=lambda: [(1, u)
+                                                 for u in ready])
+    lb.start()
+    try:
+        assert _get(lb.endpoint + '/work')[0] == 200   # learn 4000
+        state['backlog'] = 3900.0                      # drains fast...
+        assert _get(lb.endpoint + '/work')[0] == 200
+        ready.clear()                                  # ...then gone
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(lb.endpoint + '/work')
+        assert err.value.code == 503
+        retry_after = int(err.value.headers['Retry-After'])
+        # The derived value: ceil(last-known backlog / drain-rate
+        # EWMA), clamped to [1, 60].  Recompute it from the LB's own
+        # state (stable — no observations occur after the ready set
+        # emptied), so the assertion is deterministic however fast or
+        # slow the two teaching round-trips were.
+        import math
+        rate = lb._drain_rate_tok_s
+        tokens = lb._last_backlog_obs[0]
+        assert rate is not None and rate > 0       # EWMA is warm
+        assert tokens == 3900.0
+        expected = int(min(60, max(1, math.ceil(tokens / rate))))
+        assert retry_after == expected
+    finally:
+        lb.stop()
+        stop_replica()

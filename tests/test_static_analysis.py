@@ -113,11 +113,16 @@ def test_fixture_findings_land_where_expected():
     # mirror (dbok/utils/db_utils.py) is clean.
     db = by_rule['db-discipline']
     assert {f.path for f in db} == {'bad_db.py'}
-    # unbounded-io: two missing timeouts + the hot retry loop; the good
-    # file is clean.
+    # unbounded-io: two missing timeouts + the hot retry loop in the
+    # provisioning fixture, plus the KV-transfer twin (handoff push
+    # without timeout, hot handoff retry loop); the good file is clean.
     ub = by_rule['unbounded-io']
-    assert {f.path for f in ub} == {'provision/bad_unbounded.py'}
-    assert sum('retry loop' in f.message for f in ub) == 1
+    assert {f.path for f in ub} == {'provision/bad_unbounded.py',
+                                    'inference/bad_kv_transfer.py'}
+    assert sum('retry loop' in f.message for f in ub) == 2
+    kv = [f for f in ub if f.path == 'inference/bad_kv_transfer.py']
+    assert len(kv) == 2
+    assert any('session.post' in f.message for f in kv)
     # metric-naming: _total / unit-suffix / legal-name / _HELP checks,
     # plus the span-registry half (legal dotted names, SPAN_HELP).
     mn = ' '.join(f.message for f in by_rule['metric-naming'])
