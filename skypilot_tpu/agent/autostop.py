@@ -11,6 +11,10 @@ that is exactly how the reference's skylet does it).
 Stop-vs-down semantics are decided at *set* time by core.autostop (TPU
 pods cannot stop, sky/clouds/gcp.py:219-226 — callers must pass down);
 the agent just executes what was configured.
+
+VM-LOCAL BY DESIGN: like agent/job_queue.py, this sqlite DB never
+rides SKYTPU_DB_URL — autostop must keep working when the cluster
+cannot reach the control plane at all.
 """
 from __future__ import annotations
 

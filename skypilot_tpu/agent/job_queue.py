@@ -2,6 +2,12 @@
 
 Jobs persist in sqlite on the head host; states mirror the reference's
 JobStatus (job_lib.py:156) minus Ray-specific ones.
+
+VM-LOCAL BY DESIGN: this DB never rides SKYTPU_DB_URL / the shared
+Postgres backend (it passes a plain path, so state.backend_for always
+picks sqlite).  The queue must work while the cluster is partitioned
+from the control plane, and a thousand TPU VMs dialing one Postgres
+would put every VM inside the control plane's failure domain.
 """
 from __future__ import annotations
 

@@ -1,13 +1,14 @@
 """db-discipline: ONE database access layer.
 
-ROADMAP item 3 swaps Postgres under the state stores by changing a
-single funnel (`utils/db_utils.py` and the four state modules it
-serves).  That swap is only a small diff while every sqlite connection
-in the tree flows through the funnel — a stray ``sqlite3.connect``
-anywhere else becomes a silent second source of truth that the
-Postgres backend will not see.  This rule pins the funnel: direct
-``sqlite3.connect`` (or holding the ``sqlite3`` import at all) is only
-legal in the allowlisted state modules.
+The state-backend subsystem (skypilot_tpu/state/: sqlite + Postgres
+selected by DSN) swaps cleanly precisely because every connection in
+the tree flows through the ``utils/db_utils.py`` funnel — a stray
+``sqlite3.connect`` (or a stray ``psycopg.connect``) anywhere else is
+a silent second source of truth that the other backend will not see,
+and that the lease/claim protocol cannot protect.  This rule pins the
+funnel: holding the ``sqlite3`` **or** ``psycopg`` import at all is
+only legal in the backend implementations under ``state/`` (plus the
+funnel itself and the state modules written against it).
 """
 from __future__ import annotations
 
@@ -17,21 +18,30 @@ from typing import List
 from skypilot_tpu.analysis import callgraph as cg
 from skypilot_tpu.analysis.core import Finding, Project, Rule
 
-# The funnel Postgres will swap under (ROADMAP item 3).
+# The funnel + the backends behind it + the state modules above it.
 ALLOWED_FILES = (
-    'utils/db_utils.py',          # the connection funnel itself
+    'utils/db_utils.py',          # the op-set funnel itself
+    'state/__init__.py',          # backend selection (DSN dispatch)
+    'state/sqlite.py',            # sqlite backend (holds sqlite3)
+    'state/postgres.py',          # Postgres backend (holds psycopg)
+    'state/dialect.py',           # SQL translation (no connections)
+    'state/leases.py',            # heartbeat leases (via db_utils)
     'global_user_state.py',       # cluster/user state
     'jobs/state.py',              # managed-jobs state
     'serve/serve_state.py',       # serve services/replicas
     'server/requests_db.py',      # API request records
 )
 
+# Driver modules whose import anywhere else breaks the funnel.
+_DB_MODULES = ('sqlite3', 'psycopg', 'psycopg2')
+
 
 class DbDisciplineRule(Rule):
     name = 'db-discipline'
     suppress_token = 'db'
-    description = ('direct sqlite3 use outside the state-store funnel '
-                   '(utils/db_utils.py + the four state modules)')
+    description = ('direct sqlite3/psycopg use outside the state-store '
+                   'funnel (utils/db_utils.py + skypilot_tpu/state/ '
+                   'backends + the state modules)')
 
     def check(self, project: Project) -> List[Finding]:
         findings: List[Finding] = []
@@ -42,29 +52,32 @@ class DbDisciplineRule(Rule):
             for node in ast.walk(module.tree):
                 if isinstance(node, ast.Import):
                     for a in node.names:
-                        if a.name.split('.')[0] == 'sqlite3':
+                        if a.name.split('.')[0] in _DB_MODULES:
                             findings.append(project.finding(
                                 self, module, node,
-                                'import sqlite3 outside the DB access '
-                                'layer — all connections must flow '
-                                'through utils/db_utils.py (the funnel '
-                                'the Postgres backend swaps under)'))
+                                f'import {a.name.split(".")[0]} outside '
+                                f'the DB access layer — all connections '
+                                f'must flow through utils/db_utils.py '
+                                f'(the funnel the state backends live '
+                                f'behind)'))
                 elif isinstance(node, ast.ImportFrom):
-                    if (node.module or '').split('.')[0] == 'sqlite3':
+                    root = (node.module or '').split('.')[0]
+                    if root in _DB_MODULES:
                         findings.append(project.finding(
                             self, module, node,
-                            'from sqlite3 import ... outside the DB '
-                            'access layer — use utils/db_utils.py'))
+                            f'from {root} import ... outside the DB '
+                            f'access layer — use utils/db_utils.py'))
                 elif isinstance(node, ast.Call):
                     dotted = cg._dotted(node.func)
                     if dotted is None:
                         continue
                     resolved = cg.resolve_alias(dotted, module)
-                    if resolved.startswith('sqlite3.'):
+                    if resolved.split('.')[0] in _DB_MODULES:
                         findings.append(project.finding(
                             self, module, node,
                             f'{resolved}(...) outside the DB access '
-                            f'layer — all sqlite goes through '
-                            f'utils/db_utils.py so ROADMAP item 3 can '
-                            f'swap Postgres under one funnel'))
+                            f'layer — all database connections go '
+                            f'through utils/db_utils.py so both '
+                            f'backends (sqlite, Postgres) see one '
+                            f'source of truth'))
         return findings

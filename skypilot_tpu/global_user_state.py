@@ -30,8 +30,10 @@ class ClusterStatus(enum.Enum):
 
 
 def _db_path() -> str:
-    return os.path.expanduser(
-        os.environ.get('SKYTPU_STATE_DB', '~/.skytpu/state.db'))
+    # Control-plane store: rides SKYTPU_DB_URL (Postgres) when the
+    # deployment scales past one API-server node; sqlite path otherwise.
+    return db_utils.control_plane_dsn('SKYTPU_STATE_DB',
+                                      '~/.skytpu/state.db')
 
 
 _DDL = [

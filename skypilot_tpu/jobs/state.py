@@ -50,8 +50,10 @@ TERMINAL_STATUS_VALUES = frozenset(s.value for s in _TERMINAL)
 
 
 def _db_path() -> str:
-    return os.path.expanduser(
-        os.environ.get('SKYTPU_JOBS_DB', '~/.skytpu/managed_jobs.db'))
+    # Control-plane store: shared Postgres when SKYTPU_DB_URL is set,
+    # per-host sqlite otherwise.
+    return db_utils.control_plane_dsn('SKYTPU_JOBS_DB',
+                                      '~/.skytpu/managed_jobs.db')
 
 
 _DDL = [
@@ -92,7 +94,12 @@ def log_path(job_id: int) -> str:
     """Controller-side snapshot of the job's run log, persisted before the
     ephemeral task cluster is torn down (parity: the reference controller
     downloads logs, sky/jobs/controller.py:201)."""
-    return os.path.join(os.path.dirname(_db_path()), 'managed_jobs_logs',
+    # Log snapshots are FILES and stay host-local even when the job
+    # TABLE lives in Postgres (anchored on the sqlite path's directory,
+    # not the DSN).
+    local = os.path.expanduser(
+        os.environ.get('SKYTPU_JOBS_DB', '~/.skytpu/managed_jobs.db'))
+    return os.path.join(os.path.dirname(local), 'managed_jobs_logs',
                         f'{job_id}.log')
 
 

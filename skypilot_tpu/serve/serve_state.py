@@ -6,7 +6,6 @@ from __future__ import annotations
 
 import enum
 import json
-import os
 import time
 from typing import Any, Dict, List, Optional
 
@@ -46,8 +45,10 @@ class ReplicaStatus(enum.Enum):
 
 
 def _db_path() -> str:
-    return os.path.expanduser(
-        os.environ.get('SKYTPU_SERVE_DB', '~/.skytpu/services.db'))
+    # Control-plane store: shared Postgres when SKYTPU_DB_URL is set,
+    # per-host sqlite otherwise.
+    return db_utils.control_plane_dsn('SKYTPU_SERVE_DB',
+                                      '~/.skytpu/services.db')
 
 
 _DDL = [

@@ -207,9 +207,20 @@ def make_app() -> web.Application:
     app['draining'] = False
 
     async def on_cleanup(app):
+        if 'leadership_stop' in app:
+            app['leadership_stop'].set()
         if 'daemons' in app:
             app['daemons'].stop()
         executor.shutdown()
+        # Graceful departure under leases: withdraw our heartbeat row
+        # and any singleton role so siblings take over IMMEDIATELY
+        # (rolling updates must not leave claims and the controller
+        # role unowned for a TTL; crashes still rely on expiry).
+        from skypilot_tpu.state import leases
+        dsn = requests_db.db_dsn()
+        if leases.lease_mode(dsn):
+            await asyncio.get_event_loop().run_in_executor(
+                None, lambda: leases.withdraw(dsn))
 
     app.on_cleanup.append(on_cleanup)
 
@@ -220,26 +231,76 @@ def make_app() -> web.Application:
         # process — consolidation mode).
         from skypilot_tpu.jobs import controller as jobs_controller
         from skypilot_tpu.serve import controller as serve_controller
+        from skypilot_tpu.state import leases
         loop = asyncio.get_event_loop()
+
+        def start_lease_machinery():
+            # Multi-node deployments (remote backend / forced lease
+            # mode): our claims stay live only while we heartbeat, and
+            # a DEAD replica's claims only get taken over if someone
+            # rescans after its lease expires — both run here, not in
+            # the optional daemons set (they are correctness, not
+            # housekeeping).
+            dsn = requests_db.db_dsn()
+            if leases.lease_mode(dsn):
+                leases.start_heartbeat(dsn)
+                executor.start_periodic_recovery(
+                    max(leases.lease_ttl_s() / 2.0, 1.0))
+
+        await loop.run_in_executor(None, start_lease_machinery)
         await loop.run_in_executor(None, executor.recover)
+
         # Controller re-adoption and background daemons run in ONE
         # worker (index 0): two workers both re-adopting the same
         # unfinished jobs/serve controllers would double-drive them.
         # Fresh controllers still start in whichever worker accepts the
         # request — per-job/per-service threads are process-local.
-        if app.get('worker_index', 0) == 0:
-            await loop.run_in_executor(
-                None, jobs_controller.maybe_start_controllers)
-            await loop.run_in_executor(
-                None, serve_controller.maybe_start_controllers)
-        # Background daemons: requests GC, cloud-truth status refresh,
-        # controller liveness.  SKYTPU_DAEMONS=0 disables (tests).
-        if os.environ.get('SKYTPU_DAEMONS', '1') != '0' and \
-                app.get('worker_index', 0) == 0:
-            from skypilot_tpu.server import daemons as daemons_lib
-            app['daemons'] = daemons_lib.DaemonSet(
-                daemons_lib.default_daemons())
-            app['daemons'].start()
+        daemons_on = os.environ.get('SKYTPU_DAEMONS', '1') != '0'
+
+        def become_controller_owner():
+            jobs_controller.maybe_start_controllers()
+            serve_controller.maybe_start_controllers()
+            # Background daemons: requests GC, cloud-truth status
+            # refresh, controller liveness.  SKYTPU_DAEMONS=0
+            # disables (tests).
+            if daemons_on and 'daemons' not in app:
+                from skypilot_tpu.server import daemons as daemons_lib
+                app['daemons'] = daemons_lib.DaemonSet(
+                    daemons_lib.default_daemons())
+                app['daemons'].start()
+
+        if app.get('worker_index', 0) != 0:
+            return
+        dsn = requests_db.db_dsn()
+        if not leases.lease_mode(dsn):
+            await loop.run_in_executor(None, become_controller_owner)
+            return
+
+        # Multi-REPLICA deployments (shared backend): worker-0-of-pod
+        # is not enough — every pod has a worker 0, and N pods each
+        # driving the same unfinished jobs/serve controllers would
+        # double-drive them.  The 'controllers' singleton lease picks
+        # exactly one owner across the fleet; the losers keep retrying
+        # so the role fails over one TTL after the owner dies.  (A
+        # partitioned ex-owner cannot be stopped remotely — its writes
+        # stay bounded by the guarded CAS status transitions — and a
+        # live owner re-affirms, so healthy leadership never moves.)
+        import threading
+        stop = app['leadership_stop'] = threading.Event()
+
+        def leadership_loop():
+            while not stop.is_set():
+                try:
+                    if leases.try_acquire_singleton(dsn, 'controllers'):
+                        become_controller_owner()
+                except Exception:  # pylint: disable=broad-except
+                    logger.exception('controller leadership tick failed')
+                if stop.wait(max(leases.lease_ttl_s() / 2.0, 1.0)):
+                    return
+
+        threading.Thread(target=leadership_loop,
+                         name='skytpu-controller-leader',
+                         daemon=True).start()
 
     app.on_startup.append(on_startup)
 

@@ -116,6 +116,8 @@ class RequestExecutor:
         # queued for a pool slot) — what drain() must wait out.
         self._active: set = set()
         self._lock = threading.Lock()
+        self._recovery_stop = threading.Event()
+        self._recovery_thread: Optional[threading.Thread] = None
 
     # ----- LONG: per-request worker process ----------------------------------
     def submit_process(self, name: str, body: Dict[str, Any]) -> str:
@@ -202,24 +204,70 @@ class RequestExecutor:
         """
         import os
         from skypilot_tpu.server import handlers
+        from skypilot_tpu.state import leases
         me = os.getpid()
+        lease_mode = leases.lease_mode(requests_db.db_dsn())
+        # One liveness verdict per claimer per scan: the periodic pump
+        # re-runs this against a possibly-remote DB, and N rows claimed
+        # by the same sibling need one heartbeat lookup, not N.
+        live_memo: Dict[str, bool] = {}
+
+        def _inst_live(inst: str, claim_at) -> bool:
+            if inst not in live_memo:
+                live_memo[inst] = requests_db.claim_is_live(
+                    None, claim_at, inst)
+            return live_memo[inst]
+
         for rec in requests_db.nonterminal_requests():
             rid = rec['request_id']
+            # Rows THIS executor is already driving are not recovery's
+            # business.  The periodic lease-recovery pump re-runs this
+            # scan while our own dispatches are mid-flight: a claimed
+            # row sits PENDING until its worker stamps RUNNING, and
+            # re-claiming our own row here would dispatch it twice
+            # (and re-adopting an already-supervised worker would pile
+            # a supervisor onto the LONG pool every tick).
+            with self._lock:
+                ours = rid in self._active or rid in self._procs
+            if ours:
+                continue
+            claim_inst = rec.get('claim_instance')
+            if lease_mode and claim_inst is not None and \
+                    claim_inst == leases.instance_id():
+                # Claimed by our own instance but not in self._active:
+                # only possible for thread-work whose closure already
+                # finished the bookkeeping race — never steal or fail
+                # our own live claims; the owning thread writes the
+                # terminal status.
+                continue
             # A row claimed by a LIVE sibling server process is that
             # sibling's business — RUNNING thread work (pid NULL) and
             # its queued short requests would otherwise be marked
             # FAILED here while the sibling is actively executing them
             # (multi-worker: late-booting/respawned workers run this
             # scan while siblings serve).
-            sibling = (rec['claim_pid'] and rec['claim_pid'] != me and
-                       requests_db.claim_is_live(rec['claim_pid'],
-                                                 rec['claim_at']))
+            if lease_mode and claim_inst is not None:
+                # Multi-node: ownership is the INSTANCE lease — pids
+                # collide across hosts, so never compare them here.
+                sibling = (claim_inst != leases.instance_id() and
+                           _inst_live(claim_inst, rec['claim_at']))
+            else:
+                sibling = bool(
+                    rec['claim_pid'] and rec['claim_pid'] != me and
+                    requests_db.claim_is_live(rec['claim_pid'],
+                                              rec['claim_at']))
             if sibling:
                 continue          # the sibling supervises its own work
             if rec['status'] is RequestStatus.RUNNING:
                 pid = rec['pid']
+                # Multi-node: a worker pid recorded by an instance on
+                # ANOTHER host is uncheckable (and unadoptable) here —
+                # its lease is dead (the sibling check above), so the
+                # node is gone and the worker with it.
+                foreign = (lease_mode and claim_inst is not None and
+                           not leases.same_host(claim_inst))
                 alive = False
-                if pid:
+                if pid and not foreign:
                     try:
                         os.kill(pid, 0)
                         alive = True
@@ -261,6 +309,31 @@ class RequestExecutor:
                     rid, RequestStatus.FAILED,
                     error='server restarted before this request started; '
                           'resubmit it')
+
+    def start_periodic_recovery(self, interval_s: float) -> None:
+        """Re-run recover() on a timer — the lease-takeover pump.
+
+        Startup recovery alone cannot take over a sibling replica's
+        rows: when the sibling dies, nobody restarts (the survivors are
+        already up), and a lease looks live until one TTL after the
+        last heartbeat.  A periodic rescan is what turns 'stealable' in
+        to 'stolen'.  recover() is CAS-guarded end to end, so N
+        replicas pumping concurrently still dispatch each row once.
+        """
+        if self._recovery_thread is not None and \
+                self._recovery_thread.is_alive():
+            return
+
+        def loop():
+            while not self._recovery_stop.wait(interval_s):
+                try:
+                    self.recover()
+                except Exception:  # pylint: disable=broad-except
+                    logger.exception('periodic lease recovery failed')
+
+        self._recovery_thread = threading.Thread(
+            target=loop, name='skytpu-lease-recovery', daemon=True)
+        self._recovery_thread.start()
 
     def _adopt(self, request_id: str, pid: int) -> None:
         """Supervise a worker inherited from a previous server run."""
@@ -359,6 +432,9 @@ class RequestExecutor:
         return request_id
 
     def shutdown(self) -> None:
+        self._recovery_stop.set()
+        if self._recovery_thread is not None:
+            self._recovery_thread.join(timeout=2.0)
         with self._lock:
             procs = list(self._procs.values())
         for proc in procs:

@@ -43,6 +43,15 @@ _HELP = {
     'skytpu_requests_in_flight': 'Requests currently executing',
     'skytpu_request_duration_seconds': 'Request wall time',
     'skytpu_server_start_time_seconds': 'Unix time the server started',
+    # ----- state backend (utils/db_utils funnel) --------------------------
+    'skytpu_db_op_seconds':
+        'State-backend operation wall time (transaction / execute / '
+        'query / ensure_schema), labeled backend=sqlite|postgres — the '
+        'control plane\'s DB latency, the first signal a deployment '
+        'has outgrown one sqlite writer',
+    'skytpu_db_op_errors_total':
+        'State-backend operations that raised, by backend and op '
+        '(Postgres: includes connection loss; sqlite: lock timeouts)',
     # ----- k8s pod scraping (metrics_utils) ------------------------------
     'skytpu_k8s_pod_tpu_chips':
         'TPU chips requested by a skytpu-managed pod',
@@ -160,6 +169,11 @@ _BUCKETS: Dict[str, Tuple[float, ...]] = {
         (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
          0.5, 1.0),
     'skytpu_lb_request_duration_seconds': DEFAULT_BUCKETS,
+    # Sub-millisecond floor: local sqlite ops are microseconds, a
+    # loaded Postgres round-trip is milliseconds — both tails matter.
+    'skytpu_db_op_seconds':
+        (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+         0.5, 1.0, 2.5, 5.0),
     'skytpu_train_step_seconds':
         (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
          60.0, 120.0),

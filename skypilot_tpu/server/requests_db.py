@@ -14,6 +14,7 @@ import time
 import uuid
 from typing import Any, Dict, List, Optional
 
+from skypilot_tpu.state import leases
 from skypilot_tpu.utils import db_utils
 
 
@@ -30,8 +31,16 @@ class RequestStatus(enum.Enum):
 
 
 def _db_path() -> str:
-    return os.path.expanduser(
-        os.environ.get('SKYTPU_REQUESTS_DB', '~/.skytpu/requests.db'))
+    # The shared queue: Postgres when SKYTPU_DB_URL is set (multi-NODE
+    # API servers), per-host sqlite otherwise (multi-process per node).
+    return db_utils.control_plane_dsn('SKYTPU_REQUESTS_DB',
+                                      '~/.skytpu/requests.db')
+
+
+def db_dsn() -> str:
+    """The requests-queue DSN (public: app startup decides whether to
+    run the lease heartbeat from it)."""
+    return _ensure()
 
 
 _DDL = [
@@ -56,6 +65,11 @@ _DDL = [
     # the claim cannot be the claimer).
     'ALTER TABLE requests ADD COLUMN claim_pid INTEGER',
     'ALTER TABLE requests ADD COLUMN claim_at REAL',
+    # Which server INSTANCE (host:pid:nonce, state/leases.py) claimed.
+    # Pid liveness is only meaningful same-host; when the backend is
+    # remote (Postgres, multi-node) claim liveness is the instance's
+    # heartbeat lease instead.
+    'ALTER TABLE requests ADD COLUMN claim_instance TEXT',
     # Worker peak RSS in KB, recorded at completion (parity:
     # sky/server/requests/executor.py:570 per-request memory
     # accounting) — the capacity-planning signal for sizing API hosts.
@@ -86,14 +100,22 @@ def create(name: str, body: Dict[str, Any],
     a thread closure — and marked FAILED while this worker executes it."""
     request_id = uuid.uuid4().hex[:16]
     now = time.time()
+    path = _ensure()
+    claim_instance = None
+    if claim_pid is not None and leases.lease_mode(path):
+        # Born-claimed under leases: the claim names our instance and
+        # our heartbeat must already be fresh, or a sibling replica
+        # could judge the brand-new claim stale and steal it.
+        claim_instance = leases.instance_id()
+        leases.ensure_heartbeat(path)
     db_utils.execute(
-        _ensure(),
+        path,
         'INSERT INTO requests (request_id, name, status, created_at, body, '
-        'schedule_type, user, claim_pid, claim_at) '
-        'VALUES (?,?,?,?,?,?,?,?,?)',
+        'schedule_type, user, claim_pid, claim_at, claim_instance) '
+        'VALUES (?,?,?,?,?,?,?,?,?,?)',
         (request_id, name, RequestStatus.PENDING.value, now,
          json.dumps(body), schedule_type, body.get('_user'), claim_pid,
-         now if claim_pid is not None else None))
+         now if claim_pid is not None else None, claim_instance))
     return request_id
 
 
@@ -162,16 +184,38 @@ def try_claim(request_id: str, pid: int) -> bool:
     a live claimer is respected; a dead claimer's row is stealable —
     that is what lets N workers run recovery concurrently without
     double-dispatching (the one write wins, rowcount tells the loser).
-    A pid that started AFTER the claim was made cannot be the claimer
-    (pid recycling, e.g. post-reboot) — such rows are stealable too,
-    or a PENDING row could hang forever behind an unrelated process.
+
+    Liveness of the previous claimer depends on the deployment shape:
+
+    - same-host (sqlite backend): pid probe + /proc start-time guard —
+      a pid that started AFTER the claim was made cannot be the claimer
+      (pid recycling, e.g. post-reboot), or a PENDING row could hang
+      forever behind an unrelated process;
+    - multi-node (remote backend / lease mode): the claimer's heartbeat
+      LEASE (state/leases.py) — a claim whose instance stopped beating
+      one TTL ago is stealable (stale-lease takeover), and the CAS runs
+      on the instance column so two replicas racing for the same stale
+      row still produce exactly one winner.
     """
     path = _ensure()
     row = db_utils.query_one(
-        path, 'SELECT claim_pid, claim_at, status FROM requests '
-        'WHERE request_id=?', (request_id,))
+        path, 'SELECT claim_pid, claim_at, claim_instance, status '
+        'FROM requests WHERE request_id=?', (request_id,))
     if row is None or row['status'] != RequestStatus.PENDING.value:
         return False
+    if leases.lease_mode(path):
+        mine = leases.instance_id()
+        leases.ensure_heartbeat(path)
+        old_inst = row['claim_instance']
+        if old_inst is not None and old_inst != mine and \
+                leases.is_live(path, old_inst):
+            return False
+        return db_utils.execute_rowcount(
+            path, 'UPDATE requests SET claim_pid=?, claim_at=?, '
+            'claim_instance=? '
+            'WHERE request_id=? AND claim_instance IS ? AND status=?',
+            (pid, time.time(), mine, request_id, old_inst,
+             RequestStatus.PENDING.value)) == 1
     old = row['claim_pid']
     if old is not None and old != pid and _pid_alive(old):
         started = _pid_start_time(old)
@@ -205,8 +249,10 @@ def get(request_id: str) -> Optional[Dict[str, Any]]:
     row = db_utils.query_one(
         _ensure(), 'SELECT * FROM requests WHERE request_id=?',
         (request_id,))
-    if row is None:
-        return None
+    return _record(row) if row is not None else None
+
+
+def _record(row) -> Dict[str, Any]:
     return {
         'request_id': row['request_id'],
         'name': row['name'],
@@ -221,14 +267,26 @@ def get(request_id: str) -> Optional[Dict[str, Any]]:
         'user': row['user'],
         'claim_pid': row['claim_pid'],
         'claim_at': row['claim_at'],
+        'claim_instance': row['claim_instance'],
     }
 
 
 def claim_is_live(claim_pid: Optional[int],
-                  claim_at: Optional[float]) -> bool:
-    """True if the claiming server process is still the claimer: alive,
-    and not a recycled pid (a process that started after the claim was
-    made cannot be the claimer)."""
+                  claim_at: Optional[float],
+                  claim_instance: Optional[str] = None) -> bool:
+    """True if the claiming server process is still the claimer.
+
+    Lease mode (remote backend / SKYTPU_DB_LEASES): the claimer is live
+    iff its instance's heartbeat lease is — the only check that means
+    anything across hosts.  Same-host mode: pid alive and not recycled
+    (a process that started after the claim was made cannot be the
+    claimer)."""
+    path = _ensure()
+    if leases.lease_mode(path):
+        # Rows claimed before the lease migration carry no instance;
+        # fall through to the pid check for those legacy rows only.
+        if claim_instance is not None:
+            return leases.is_live(path, claim_instance)
     if not claim_pid or not _pid_alive(claim_pid):
         return False
     started = _pid_start_time(claim_pid)
@@ -245,26 +303,28 @@ def record_peak_rss(request_id: str, kb: int) -> None:
 
 
 def list_requests(limit: int = 100) -> List[Dict[str, Any]]:
+    # One query, full rows: the old id-list + per-id get() was 1+N
+    # round-trips (and could see the requests-GC daemon prune a row
+    # between the two reads); a single SELECT is one round-trip and
+    # one consistent snapshot — which matters doubly now that the DB
+    # can be a remote Postgres.
     rows = db_utils.query(
         _ensure(),
-        'SELECT request_id FROM requests ORDER BY created_at DESC LIMIT ?',
+        'SELECT * FROM requests ORDER BY created_at DESC LIMIT ?',
         (limit,))
-    # The requests-GC daemon can prune a terminal row between the id
-    # SELECT and the per-id fetch; drop the resulting Nones so callers
-    # (the GET /requests route) never see a phantom entry.
-    found = (get(r['request_id']) for r in rows)
-    return [req for req in found if req is not None]
+    return [_record(r) for r in rows]
 
 
 def nonterminal_requests() -> List[Dict[str, Any]]:
     """PENDING/RUNNING rows — the persisted queue the server re-adopts
-    after a restart (the requests DB IS the sqlite queue transport)."""
+    after a restart, and the lease-recovery pump's periodic scan (so
+    this is one round-trip, not 1+N: against Postgres it runs every
+    TTL/2 on every replica)."""
     rows = db_utils.query(
-        _ensure(), 'SELECT request_id FROM requests WHERE status IN (?,?) '
+        _ensure(), 'SELECT * FROM requests WHERE status IN (?,?) '
         'ORDER BY created_at',
         (RequestStatus.PENDING.value, RequestStatus.RUNNING.value))
-    found = (get(r['request_id']) for r in rows)
-    return [req for req in found if req is not None]
+    return [_record(r) for r in rows]
 
 
 def prune(max_age_s: float) -> int:

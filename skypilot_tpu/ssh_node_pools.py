@@ -37,8 +37,10 @@ def pools_file() -> str:
 
 
 def _alloc_db() -> str:
-    path = os.path.expanduser(
-        os.environ.get('SKYTPU_SSH_ALLOC_DB', '~/.skytpu/ssh_alloc.db'))
+    # Control-plane store: host allocations must be consistent across
+    # API-server replicas, so this rides SKYTPU_DB_URL too.
+    path = db_utils.control_plane_dsn('SKYTPU_SSH_ALLOC_DB',
+                                      '~/.skytpu/ssh_alloc.db')
     db_utils.ensure_schema(path, [
         """CREATE TABLE IF NOT EXISTS allocations (
             pool TEXT,

@@ -1,0 +1,182 @@
+"""Postgres state backend (psycopg 3, import-guarded).
+
+Selected when the control-plane DB URL starts with ``postgresql://``
+(state.backend_for).  The four state modules keep speaking sqlite SQL;
+every statement is translated by state/dialect.py on its way to the
+server, and rows come back as :class:`Row` objects that behave like
+``sqlite3.Row`` (index access, name access, ``.keys()``) so the
+modules cannot tell the backends apart.
+
+psycopg is imported lazily inside the backend: deployments on the
+sqlite default (every agent VM, most dev laptops) never pay the import
+and never need the dependency installed.  Connections are cached
+per-thread per-URL, autocommit by default (reads never pin a
+transaction open); ``transaction()`` opens an explicit transaction
+block so multi-statement read-modify-write sections keep their sqlite
+semantics.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Iterator, List, Optional, Sequence, Tuple
+
+from skypilot_tpu.state import dialect
+
+_local = threading.local()
+
+
+class Row:
+    """sqlite3.Row-compatible row: ``row[0]``, ``row['col']``,
+    ``row.keys()``."""
+
+    __slots__ = ('_cols', '_vals')
+
+    def __init__(self, cols: Sequence[str], vals: Sequence[Any]) -> None:
+        self._cols = cols
+        self._vals = vals
+
+    def __getitem__(self, key):
+        if isinstance(key, int):
+            return self._vals[key]
+        return self._vals[self._cols.index(key)]
+
+    def keys(self) -> List[str]:
+        return list(self._cols)
+
+    def __iter__(self):
+        return iter(self._vals)
+
+    def __len__(self) -> int:
+        return len(self._vals)
+
+    def __repr__(self) -> str:
+        return f'Row({dict(zip(self._cols, self._vals))!r})'
+
+
+def _row_factory(cursor):
+    def make(values):
+        cols = [d.name for d in cursor.description] \
+            if cursor.description else []
+        return Row(cols, values)
+    return make
+
+
+class _Cursor:
+    """Cursor facade exposing the sqlite surface the state modules use:
+    rowcount, fetchone/fetchall, lastrowid (via lastval())."""
+
+    def __init__(self, pg_cursor, pg_conn) -> None:
+        self._cur = pg_cursor
+        self._conn = pg_conn
+
+    @property
+    def rowcount(self) -> int:
+        return self._cur.rowcount
+
+    def fetchone(self) -> Optional[Row]:
+        return self._cur.fetchone()
+
+    def fetchall(self) -> List[Row]:
+        return self._cur.fetchall()
+
+    @property
+    def lastrowid(self) -> int:
+        # sqlite's cursor.lastrowid after an identity-column INSERT:
+        # lastval() reads the same session's most recent sequence value.
+        row = self._conn.execute('SELECT lastval()').fetchone()
+        return int(row[0])
+
+
+class _Conn:
+    """Connection facade: translates every statement through the
+    dialect before it reaches the server."""
+
+    def __init__(self, pg_conn) -> None:
+        self._pg = pg_conn
+
+    def execute(self, sql: str, params: Tuple = ()) -> _Cursor:
+        translated = dialect.to_postgres(sql)
+        if translated is None:         # PRAGMA etc: no pg counterpart
+            return _Cursor(self._pg.execute('SELECT 1'), self._pg)
+        return _Cursor(self._pg.execute(translated, params), self._pg)
+
+
+class PostgresBackend:
+    name = 'postgres'
+
+    def __init__(self, url: str) -> None:
+        # Import here, not at module top: the sqlite default must work
+        # on hosts without psycopg installed (agent VMs, dev machines).
+        try:
+            import psycopg  # pylint: disable=import-outside-toplevel
+        except ImportError as e:
+            raise RuntimeError(
+                'SKYTPU_DB_URL points at Postgres but psycopg is not '
+                'installed; pip install "psycopg[binary]" on the API '
+                'server image (agents stay on sqlite and do not need '
+                'it)') from e
+        self._psycopg = psycopg
+        self._url = url
+
+    def _connect(self):
+        conns = getattr(_local, 'pg_conns', None)
+        if conns is None:
+            conns = _local.pg_conns = {}
+        conn = conns.get(self._url)
+        if conn is None or conn.closed:
+            conn = self._psycopg.connect(self._url,
+                                         row_factory=_row_factory)
+            conn.autocommit = True
+            conns[self._url] = conn
+        return conn
+
+    # ----- the operation set ----------------------------------------------
+    @contextlib.contextmanager
+    def transaction(self) -> Iterator[_Conn]:
+        conn = self._connect()
+        with conn.transaction():
+            yield _Conn(conn)
+
+    def execute(self, sql: str, params: Tuple = ()) -> None:
+        with self.transaction() as conn:
+            conn.execute(sql, params)
+
+    def execute_rowcount(self, sql: str, params: Tuple = ()) -> int:
+        with self.transaction() as conn:
+            return conn.execute(sql, params).rowcount
+
+    def query(self, sql: str, params: Tuple = ()) -> List[Row]:
+        return _Conn(self._connect()).execute(sql, params).fetchall()
+
+    def query_one(self, sql: str, params: Tuple = ()) -> Optional[Row]:
+        rows = self.query(sql, params)
+        return rows[0] if rows else None
+
+    # Advisory-lock key serializing schema replay: Postgres's CREATE
+    # TABLE IF NOT EXISTS is not concurrency-safe (two sessions racing
+    # the same CREATE can abort one with a pg_type duplicate-key error)
+    # and N replicas boot simultaneously on first deploy.
+    _SCHEMA_LOCK_KEY = 0x5CE7A  # 'SCHEMA', arbitrary but stable
+
+    def ensure_schema(self, ddl: List[str]) -> None:
+        # Register first: the upsert rewrite needs every table's PK and
+        # column set before any INSERT OR REPLACE translates.
+        for stmt in ddl:
+            dialect.register_ddl(stmt)
+        with self.transaction() as conn:
+            # Transaction-scoped advisory lock: released at commit, so
+            # concurrent booting replicas replay DDL one at a time.
+            conn.execute(
+                f'SELECT pg_advisory_xact_lock({self._SCHEMA_LOCK_KEY})')
+            for stmt in ddl:
+                conn.execute(stmt)
+
+
+def reset_connections_for_tests() -> None:
+    conns = getattr(_local, 'pg_conns', None)
+    if conns:
+        for conn in conns.values():
+            with contextlib.suppress(Exception):
+                conn.close()
+        conns.clear()

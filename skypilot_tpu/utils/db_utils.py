@@ -1,88 +1,107 @@
-"""Tiny sqlite helper: per-path connection cache, WAL, dict rows.
+"""The ONE database access layer (skytpu check: db-discipline).
 
-The reference uses SQLAlchemy over sqlite/Postgres
-(sky/global_user_state.py:22-117); sqlalchemy is not in this environment,
-and sqlite3 + WAL covers the single-host API-server deployment.  The schema
-layer is written against this module so a Postgres backend can be slotted in
-behind the same interface later.
+Callers pass a DSN — a sqlite file path (default) or a
+``postgresql://`` URL — and this module dispatches to the matching
+backend in skypilot_tpu/state/ (sqlite: per-thread conns + WAL;
+Postgres: psycopg with sqlite-dialect translation).  The operation set
+is unchanged from the sqlite-only era, so the state modules are
+backend-blind:
+
+- ``transaction(dsn)`` — multi-statement atomic section;
+- ``execute`` / ``execute_rowcount`` — the latter is the
+  compare-and-swap primitive (UPDATE ... WHERE <expected old value>);
+- ``query`` / ``query_one``;
+- ``ensure_schema`` — idempotent DDL replay (ADD COLUMN re-runs are
+  detected by catalog introspection, not error-string matching).
+
+Every operation is timed into ``skytpu_db_op_seconds`` and failures
+counted in ``skytpu_db_op_errors_total``, labeled
+``backend=sqlite|postgres`` — the first signal that a control plane is
+outgrowing its single sqlite writer is this histogram's tail.
 """
 from __future__ import annotations
 
 import contextlib
-import os
-import sqlite3
 import threading
-from typing import Any, Iterator, List, Optional, Tuple
+import time
+from typing import Any, Iterator, List, Optional, Set, Tuple
 
-_local = threading.local()
+from skypilot_tpu import state
+from skypilot_tpu.server import metrics as metrics_lib
+from skypilot_tpu.state import control_plane_dsn  # noqa: F401  (re-export)
+
+# ensure_schema is called by every state module before every operation
+# (the _ensure() idiom).  Replaying DDL per sqlite op is microseconds;
+# on Postgres it would be ~10 network round-trips plus a fleet-global
+# advisory lock PER OPERATION — so a (dsn, ddl) pair replays once per
+# process and is a no-op after.
+_ensured_lock = threading.Lock()
+_ensured: Set[Tuple[str, Tuple[str, ...]]] = set()
 
 
-def _connect(path: str) -> sqlite3.Connection:
-    conns = getattr(_local, 'conns', None)
-    if conns is None:
-        conns = _local.conns = {}
-    conn = conns.get(path)
-    if conn is None:
-        os.makedirs(os.path.dirname(path) or '.', exist_ok=True)
-        conn = sqlite3.connect(path, timeout=30.0)
-        conn.row_factory = sqlite3.Row
-        conn.execute('PRAGMA journal_mode=WAL')
-        conn.execute('PRAGMA synchronous=NORMAL')
-        conns[path] = conn
-    return conn
+def _backend_label(dsn: str) -> str:
+    return 'postgres' if state.is_postgres_dsn(dsn) else 'sqlite'
 
 
 @contextlib.contextmanager
-def transaction(path: str) -> Iterator[sqlite3.Connection]:
-    conn = _connect(path)
+def _timed(op: str, dsn: str) -> Iterator[None]:
+    backend = _backend_label(dsn)
+    t0 = time.perf_counter()
     try:
-        yield conn
-        conn.commit()
+        yield
     except Exception:
-        conn.rollback()
+        metrics_lib.inc_counter('skytpu_db_op_errors_total',
+                                backend=backend, op=op)
         raise
+    finally:
+        metrics_lib.observe_hist('skytpu_db_op_seconds',
+                                 time.perf_counter() - t0,
+                                 backend=backend, op=op)
 
 
-def execute(path: str, sql: str, params: Tuple = ()) -> None:
-    with transaction(path) as conn:
-        conn.execute(sql, params)
+@contextlib.contextmanager
+def transaction(dsn: str) -> Iterator[Any]:
+    # Timed as one op: the caller's whole atomic section IS the write
+    # the DB serializes (sqlite: the writer lock window).
+    with _timed('transaction', dsn):
+        with state.backend_for(dsn).transaction() as conn:
+            yield conn
 
 
-def execute_rowcount(path: str, sql: str, params: Tuple = ()) -> int:
+def execute(dsn: str, sql: str, params: Tuple = ()) -> None:
+    with _timed('execute', dsn):
+        state.backend_for(dsn).execute(sql, params)
+
+
+def execute_rowcount(dsn: str, sql: str, params: Tuple = ()) -> int:
     """Execute and return the affected-row count — the primitive for
     compare-and-swap claims (UPDATE ... WHERE <expected old value>)."""
-    with transaction(path) as conn:
-        return conn.execute(sql, params).rowcount
+    with _timed('execute', dsn):
+        return state.backend_for(dsn).execute_rowcount(sql, params)
 
 
-def query(path: str, sql: str, params: Tuple = ()) -> List[sqlite3.Row]:
-    return _connect(path).execute(sql, params).fetchall()
+def query(dsn: str, sql: str, params: Tuple = ()) -> List[Any]:
+    with _timed('query', dsn):
+        return state.backend_for(dsn).query(sql, params)
 
 
-def query_one(path: str, sql: str,
-              params: Tuple = ()) -> Optional[sqlite3.Row]:
-    rows = query(path, sql, params)
-    return rows[0] if rows else None
+def query_one(dsn: str, sql: str, params: Tuple = ()) -> Optional[Any]:
+    with _timed('query', dsn):
+        return state.backend_for(dsn).query_one(sql, params)
 
 
-def ensure_schema(path: str, ddl: List[str]) -> None:
-    with transaction(path) as conn:
-        for stmt in ddl:
-            try:
-                conn.execute(stmt)
-            except sqlite3.OperationalError as e:
-                # Idempotent migrations: ADD COLUMN re-runs on every
-                # startup; an already-present column is success.
-                if 'ADD COLUMN' in stmt.upper() and \
-                        'duplicate column' in str(e).lower():
-                    continue
-                raise
+def ensure_schema(dsn: str, ddl: List[str]) -> None:
+    key = (dsn, tuple(ddl))
+    with _ensured_lock:
+        if key in _ensured:
+            return
+    with _timed('ensure_schema', dsn):
+        state.backend_for(dsn).ensure_schema(ddl)
+    with _ensured_lock:
+        _ensured.add(key)
 
 
 def reset_connections_for_tests() -> None:
-    conns = getattr(_local, 'conns', None)
-    if conns:
-        for conn in conns.values():
-            with contextlib.suppress(Exception):
-                conn.close()
-        conns.clear()
+    state.reset_connections_for_tests()
+    with _ensured_lock:
+        _ensured.clear()

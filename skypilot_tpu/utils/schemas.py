@@ -191,6 +191,18 @@ CONFIG_SCHEMA: Dict[str, Any] = {
     'type': 'object',
     'additionalProperties': False,
     'properties': {
+        # Control-plane state backend: a postgresql:// URL moves the
+        # four state stores (clusters, requests, jobs, serve) off
+        # per-host sqlite onto one shared database — the prerequisite
+        # for running more than one API-server node.  Env
+        # SKYTPU_DB_URL overrides.  Agent-side VM DBs stay sqlite.
+        'db': {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {
+                'url': {'type': 'string'},
+            },
+        },
         'api_server': {
             'type': 'object',
             'additionalProperties': False,

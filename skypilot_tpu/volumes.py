@@ -21,7 +21,6 @@ from __future__ import annotations
 
 import dataclasses
 import json
-import os
 import time
 from typing import Any, Dict, List, Optional
 
@@ -36,8 +35,11 @@ VOLUME_TYPES = ('k8s-pvc', 'gcp-disk')
 
 
 def _db_path() -> str:
-    return os.path.expanduser(
-        os.environ.get('SKYTPU_VOLUMES_DB', '~/.skytpu/volumes.db'))
+    # Control-plane store: rides the shared Postgres backend when
+    # SKYTPU_DB_URL is set (volume records must be visible to every
+    # API-server replica), per-host sqlite otherwise.
+    return db_utils.control_plane_dsn('SKYTPU_VOLUMES_DB',
+                                      '~/.skytpu/volumes.db')
 
 
 _DDL = [

@@ -1,0 +1,18 @@
+"""Known-bad: state-backend metrics that break registry discipline —
+a histogram without a unit suffix and a counter family nobody
+registered in _HELP (the db_op families are cross-process contracts
+like every other exported family)."""
+import time
+
+from skypilot_tpu.server import metrics as metrics_lib
+
+
+def timed_op():
+    t0 = time.perf_counter()
+    # BAD: histogram name missing its unit suffix (_seconds).
+    metrics_lib.observe_hist('skytpu_db_op_millis',
+                             (time.perf_counter() - t0) * 1e3,
+                             backend='sqlite')
+    # BAD: counter not registered in _HELP.
+    metrics_lib.inc_counter('skytpu_db_op_rogue_total',
+                            backend='sqlite')

@@ -1,0 +1,9 @@
+"""Known-bad: direct psycopg outside the state-store funnel — a second
+Postgres connection path would bypass the dialect layer and the lease
+protocol."""
+import psycopg                       # BAD: holding the import at all
+
+
+def read_state(url):
+    conn = psycopg.connect(url)      # BAD: second source of truth
+    return conn.execute('SELECT 1').fetchone()

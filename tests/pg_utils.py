@@ -1,0 +1,73 @@
+"""Shared helpers for running suites against a live Postgres.
+
+Postgres coverage is opt-in: export SKYTPU_TEST_PG_URL (CI does, via a
+service container) and the postgres params of the conformance /
+multiworker / chaos suites un-skip.  Each test gets its own schema —
+the URL's ``options=-csearch_path`` pins every connection (including
+subprocess API servers that inherit the URL via SKYTPU_DB_URL) to that
+schema, so parallel tests never see each other's tables.
+"""
+import contextlib
+import os
+import uuid
+
+import pytest
+
+
+def pg_base_url():
+    return os.environ.get('SKYTPU_TEST_PG_URL', '').strip() or None
+
+
+def _psycopg_available() -> bool:
+    try:
+        import psycopg  # noqa: F401  pylint: disable=unused-import
+        return True
+    except ImportError:
+        return False
+
+
+needs_pg = pytest.mark.skipif(
+    not (pg_base_url() and _psycopg_available()),
+    reason='SKYTPU_TEST_PG_URL not set (or psycopg not installed) — '
+           'postgres-backend coverage runs in the CI service-container '
+           'job')
+
+
+BACKENDS = ['sqlite', pytest.param('postgres', marks=needs_pg)]
+
+
+def make_backend_url_fixture(prefix: str):
+    """Factory for the per-suite backend fixture: yields None for
+    sqlite, a schema-scoped Postgres URL otherwise, and resets the
+    funnel's connection/schema caches after the pg param (the schema
+    is dropped, so cached state must not leak into the next test)."""
+
+    @pytest.fixture(params=BACKENDS)
+    def backend_url(request):
+        if request.param == 'sqlite':
+            yield None
+        else:
+            with pg_schema(prefix) as url:
+                yield url
+            from skypilot_tpu.utils import db_utils
+            db_utils.reset_connections_for_tests()
+
+    return backend_url
+
+
+@contextlib.contextmanager
+def pg_schema(prefix: str):
+    """Create a throwaway schema; yield a URL whose search_path pins it."""
+    import psycopg
+    base = pg_base_url()
+    assert base, 'guard with @needs_pg'
+    schema = f'{prefix}_{uuid.uuid4().hex[:10]}'
+    with psycopg.connect(base, autocommit=True) as conn:
+        conn.execute(f'CREATE SCHEMA "{schema}"')
+    sep = '&' if '?' in base else '?'
+    url = f'{base}{sep}options=-csearch_path%3D{schema}'
+    try:
+        yield url
+    finally:
+        with psycopg.connect(base, autocommit=True) as conn:
+            conn.execute(f'DROP SCHEMA "{schema}" CASCADE')

@@ -10,6 +10,13 @@ Two failure classes the durable-requests design must survive:
 - the API server process is SIGKILLed while a request is RUNNING in a
   worker process: on restart, executor.recover() must adopt the live
   orphan worker and the request must complete with its result.
+
+Both scenarios parameterize over the state backend: sqlite (always)
+and, when SKYTPU_TEST_PG_URL is set (CI service container), a live
+Postgres — where the SIGKILL case additionally proves lease-based
+recovery: the restarted server is a NEW instance, the dead one's
+claim goes stale after its heartbeat TTL, and the periodic recovery
+pump takes the request over.
 """
 import os
 import signal
@@ -106,13 +113,24 @@ def _start_server(port, env):
     raise RuntimeError('API server never became healthy')
 
 
+from pg_utils import make_backend_url_fixture  # noqa: E402
+
+chaos_backend_url = make_backend_url_fixture('chaos')
+
+
 @pytest.fixture
-def chaos_server(tmp_path):
+def chaos_server(tmp_path, chaos_backend_url):
     home = tmp_path / 'home'
     home.mkdir()
     pid_file = tmp_path / 'agent-pids.txt'
     pid_file.touch()
     env = _server_env(home, pid_file)
+    if chaos_backend_url is not None:
+        env['SKYTPU_DB_URL'] = chaos_backend_url
+        # Fast lease expiry: the SIGKILL scenario's restarted server
+        # must judge the dead incarnation's claims stale within the
+        # test deadline.
+        env['SKYTPU_LEASE_TTL_S'] = '2.0'
     port = _free_port()
     proc = _start_server(port, env)
     yield {'port': port, 'proc': proc, 'env': env, 'home': home}

@@ -57,3 +57,36 @@ def test_deployment_contract():
     assert 'Recreate' in text
     assert '--workers' in text
     assert '/api/health' in text
+
+
+def test_multi_replica_gated_on_postgres():
+    """replicas > 1 ⇔ Postgres: the chart must refuse to render a
+    multi-replica sqlite deployment (each pod would be its own source
+    of truth), and with db.url it must inject SKYTPU_DB_URL from the
+    db Secret and switch off the single-PVC Recreate constraint."""
+    with open(os.path.join(CHART, 'templates', 'deployment.yaml'),
+              encoding='utf-8') as f:
+        text = f.read()
+    # The gate: a fail call conditioned on replicas>1 without db.url.
+    assert 'fail' in text
+    assert 'replicas > 1 requires db.url' in text
+    # The backend env var comes from the db secret, never inline.
+    assert 'SKYTPU_DB_URL' in text
+    assert 'secretKeyRef' in text
+    values = _values()
+    assert values['replicas'] == 1          # sqlite-safe default
+    assert values['db']['url'] == ''
+    with open(os.path.join(CHART, 'templates', 'db-secret.yaml'),
+              encoding='utf-8') as f:
+        secret = f.read()
+    assert '.Values.db.url' in secret
+    # The state PVC is ReadWriteOnce: it must be single-pod-only.
+    # Multi-replica pods (and the RollingUpdate they imply) must never
+    # reference it — both the PVC render and the volume selection are
+    # conditioned on replicas == 1, and Recreate tracks PVC usage.
+    assert '$usePvc' in text
+    with open(os.path.join(CHART, 'templates', 'pvc.yaml'),
+              encoding='utf-8') as f:
+        pvc = f.read()
+    assert 'eq (int .Values.replicas) 1' in pvc
+    assert 'ReadWriteOnce' in pvc

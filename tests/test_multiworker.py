@@ -5,6 +5,14 @@ The hard property is single execution: two workers running startup
 recovery over the same durable queue must dispatch each PENDING row
 exactly once (requests_db.try_claim CAS).  Drain must gate every
 worker regardless of which one served the /api/drain POST.
+
+The scenarios parameterize over the state backend: sqlite always
+(pid-based claims, one host), and — when SKYTPU_TEST_PG_URL is set
+(CI's Postgres service container) — the same workers against a shared
+Postgres, where each worker process is a distinct server INSTANCE and
+claims are heartbeat leases: the acceptance property is that two
+API-server processes with distinct instance ids sharing one Postgres
+never double-dispatch a request.
 """
 import os
 import time
@@ -12,7 +20,10 @@ import time
 import pytest
 import requests as requests_lib
 
+from pg_utils import make_backend_url_fixture
 from test_chaos import _free_port, _server_env, _start_server
+
+backend_url = make_backend_url_fixture('mw')
 
 
 def _start_multiworker(port, env, workers=2):
@@ -37,14 +48,18 @@ def _start_multiworker(port, env, workers=2):
 
 
 @pytest.fixture
-def mw_server(tmp_path):
+def mw_server(tmp_path, backend_url):
     home = tmp_path / 'home'
     home.mkdir()
     pid_file = tmp_path / 'agent-pids.txt'
     pid_file.touch()
     env = _server_env(home, pid_file)
+    if backend_url is not None:
+        env['SKYTPU_DB_URL'] = backend_url
+        # Fast lease TTL so takeover scenarios fit in test deadlines.
+        env['SKYTPU_LEASE_TTL_S'] = '3.0'
     yield {'env': env, 'home': home, 'tmp': tmp_path,
-           'pid_file': pid_file}
+           'pid_file': pid_file, 'backend_url': backend_url}
     import signal
     for line in pid_file.read_text().splitlines():
         try:
@@ -85,9 +100,13 @@ def test_two_workers_recover_pending_rows_once(mw_server, tmp_path,
     env = mw_server['env']
     # Stage rows against the server's requests DB from this process.
     monkeypatch.setenv('HOME', env['HOME'])
-    monkeypatch.setenv(
-        'SKYTPU_REQUESTS_DB',
-        os.path.join(env['HOME'], '.skytpu', 'requests.db'))
+    if mw_server['backend_url'] is not None:
+        monkeypatch.setenv('SKYTPU_DB_URL', mw_server['backend_url'])
+    else:
+        monkeypatch.delenv('SKYTPU_DB_URL', raising=False)
+        monkeypatch.setenv(
+            'SKYTPU_REQUESTS_DB',
+            os.path.join(env['HOME'], '.skytpu', 'requests.db'))
     from skypilot_tpu.server import requests_db
     markers = []
     rids = []
@@ -101,7 +120,8 @@ def test_two_workers_recover_pending_rows_once(mw_server, tmp_path,
             'cluster_name': f'mwc{i}',
         }))
     env = dict(env)
-    env['SKYTPU_REQUESTS_DB'] = os.environ['SKYTPU_REQUESTS_DB']
+    if mw_server['backend_url'] is None:
+        env['SKYTPU_REQUESTS_DB'] = os.environ['SKYTPU_REQUESTS_DB']
     port = _free_port()
     proc = _start_multiworker(port, env, workers=2)
     try:
@@ -127,6 +147,81 @@ def test_two_workers_recover_pending_rows_once(mw_server, tmp_path,
             lines = marker.read_text().splitlines()
             assert lines == ['ran'], (
                 f'{marker}: executed {len(lines)} times (want exactly 1)')
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=30)
+        except Exception:  # pylint: disable=broad-except
+            proc.kill()
+
+
+def test_dead_instance_lease_takeover_e2e(mw_server, tmp_path,
+                                          monkeypatch):
+    """Kill-the-claim-holder recovery, end to end: a PENDING launch row
+    claimed by a server instance that stopped heartbeating (crashed)
+    must be taken over and executed by a booting server once the lease
+    expires — on sqlite with lease mode forced (tier-1) and on Postgres
+    (CI), where this is exactly the multi-node failover path."""
+    import time as time_lib
+
+    env = dict(mw_server['env'])
+    monkeypatch.setenv('HOME', env['HOME'])
+    monkeypatch.setenv('SKYTPU_LEASE_TTL_S', '2.0')
+    env['SKYTPU_LEASE_TTL_S'] = '2.0'
+    if mw_server['backend_url'] is not None:
+        monkeypatch.setenv('SKYTPU_DB_URL', mw_server['backend_url'])
+    else:
+        monkeypatch.delenv('SKYTPU_DB_URL', raising=False)
+        monkeypatch.setenv('SKYTPU_DB_LEASES', '1')
+        monkeypatch.setenv(
+            'SKYTPU_REQUESTS_DB',
+            os.path.join(env['HOME'], '.skytpu', 'requests.db'))
+        env['SKYTPU_DB_LEASES'] = '1'
+        env['SKYTPU_REQUESTS_DB'] = os.environ['SKYTPU_REQUESTS_DB']
+    from skypilot_tpu.server import requests_db
+    from skypilot_tpu.state import leases
+    from skypilot_tpu.utils import db_utils
+    marker = tmp_path / 'takeover-ran.txt'
+    rid = requests_db.create('launch', {
+        'task': {'name': 'takeover',
+                 'run': f'echo ran >> {marker}',
+                 'resources': {'infra': 'local'}},
+        'cluster_name': 'takec',
+    })
+    # Claimed by a "crashed" instance: claim row + heartbeat row whose
+    # beat is already one TTL stale.
+    now = time_lib.time()
+    dsn = requests_db.db_dsn()
+    db_utils.ensure_schema(dsn, leases._DDL)
+    db_utils.execute(
+        dsn, 'UPDATE requests SET claim_instance=?, claim_pid=?, '
+        'claim_at=? WHERE request_id=?',
+        ('crashedhost:1:dead', 424242, now, rid))
+    db_utils.execute(
+        dsn, 'INSERT INTO server_instances (instance_id, host, pid, '
+        'started_at, last_heartbeat) VALUES (?,?,?,?,?)',
+        ('crashedhost:1:dead', 'crashedhost', 424242, now - 60,
+         now - 10.0))
+    port = _free_port()
+    proc = _start_multiworker(port, env, workers=1)
+    try:
+        deadline = time_lib.time() + 120
+        status = None
+        while time_lib.time() < deadline:
+            rec = requests_lib.get(
+                f'http://127.0.0.1:{port}/requests/{rid}',
+                timeout=10).json()
+            status = rec.get('status')
+            if status in ('SUCCEEDED', 'FAILED', 'CANCELLED'):
+                break
+            time_lib.sleep(0.3)
+        assert status == 'SUCCEEDED', rec.get('error')
+        assert rec['claim_instance'] != 'crashedhost:1:dead'
+        deadline = time_lib.time() + 30
+        while time_lib.time() < deadline and not marker.exists():
+            time_lib.sleep(0.2)
+        assert marker.exists()
+        assert marker.read_text().splitlines() == ['ran']
     finally:
         proc.terminate()
         try:

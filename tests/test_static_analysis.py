@@ -109,10 +109,11 @@ def test_fixture_findings_land_where_expected():
     ba = by_rule['blocking-in-async']
     assert len(ba) == 3
     assert all(f.path == 'server/bad_blocking.py' for f in ba)
-    # db-discipline: import + connect flagged; the allowlisted funnel
-    # mirror (dbok/utils/db_utils.py) is clean.
+    # db-discipline: sqlite3 AND psycopg import + connect flagged; the
+    # allowlisted funnel mirror (dbok/utils/db_utils.py) is clean.
     db = by_rule['db-discipline']
-    assert {f.path for f in db} == {'bad_db.py'}
+    assert {f.path for f in db} == {'bad_db.py', 'bad_psycopg.py'}
+    assert sum('psycopg' in f.message for f in db) == 2
     # unbounded-io: two missing timeouts + the hot retry loop in the
     # provisioning fixture, plus the KV-transfer twin (handoff push
     # without timeout, hot handoff retry loop); the good file is clean.
@@ -143,6 +144,14 @@ def test_fixture_findings_land_where_expected():
     assert 'skytpu_engine_kv_rogue_pages' in page_msgs
     assert 'skytpu_engine_prefix_cache_rogue_total' in page_msgs
     assert 'engine.prefix_rogue' in page_msgs
+    # State-backend fixture: db_op families are held to the same bar
+    # (unit suffix on the histogram, _HELP entry on both).
+    db_hits = [f for f in by_rule['metric-naming']
+               if f.path == 'bad_db_metrics.py']
+    assert len(db_hits) == 3
+    db_msgs = ' '.join(f.message for f in db_hits)
+    assert 'skytpu_db_op_millis' in db_msgs
+    assert 'skytpu_db_op_rogue_total' in db_msgs
 
 
 # ---------------------------------------------------------------------------
