@@ -826,7 +826,65 @@ def bench_launch() -> dict:
         shutil.rmtree(home, ignore_errors=True)
 
 
-def main() -> None:
+def bench_fleet(seed: int = None) -> dict:
+    """Fleet-scale simulation: the zero-hardware millions-of-users run.
+
+    Pure CPU, no device state: the canonical FLEET scenario
+    (skypilot_tpu/fleetsim) drives the REAL load balancer, autoscaler,
+    replica manager, and state backend against thousands of virtual
+    replicas through a diurnal peak, a traffic burst, a 50% decode
+    preemption storm, a leaseholder kill, and an LB sever.  Emits the
+    headline scale claim plus the per-run control-plane profile (the
+    ranked hot paths) for the sqlite backend — and for Postgres too
+    when SKYTPU_TEST_PG_URL points at a live server (the CI
+    postgres-state job does; psycopg is not in the local image).
+    """
+    import os
+
+    from skypilot_tpu.fleetsim import fleet_config, run_fleet
+    from skypilot_tpu.fleetsim import profile as fleet_profile
+
+    result = run_fleet(fleet_config(seed=seed))
+    out = {
+        'scale': {
+            'sustained_qps_at_slo': result.sustained_qps_at_slo,
+            'replicas': result.peak_replicas,
+            'pools': result.pools,
+            'storm_fraction_pct': result.storm_fraction_pct,
+            'recovery_s': result.recovery_s,
+            'headline': result.headline(),
+            'admitted': result.admitted,
+            'shed': result.shed,
+            'no_ready': result.no_ready,
+            'retried': result.retried,
+            'prefix_hit_rate': result.prefix_hit_rate,
+            'lease_frozen_s': result.lease_frozen_s,
+            'seed': result.seed,
+            'horizon_s': result.horizon_s,
+            'wall_s': result.wall_s,
+        },
+        'profile': {'sqlite': fleet_profile.top(result.profile),
+                    'postgres': None},
+    }
+    pg_url = os.environ.get('SKYTPU_TEST_PG_URL')
+    if pg_url:
+        pg = run_fleet(fleet_config(seed=seed, db=pg_url))
+        out['profile']['postgres'] = fleet_profile.top(pg.profile)
+    else:
+        out['profile']['note'] = (
+            'postgres profile needs SKYTPU_TEST_PG_URL (live server + '
+            'psycopg); the CI postgres-state job measures it')
+    return out
+
+
+def main(argv=None) -> None:
+    import argparse
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--seed', type=int, default=None,
+                        help='RNG seed for the simulation-backed '
+                             'sections (fleet); default: the canonical '
+                             'published seed')
+    args = parser.parse_args(argv)
     on_tpu = jax.default_backend() == 'tpu'
     # Control-plane first: hermetic, no device state, and the number is
     # honest-cold (no JAX executables or page cache warmed by training).
@@ -861,6 +919,10 @@ def main() -> None:
     # Disaggregated prefill/decode vs monolithic at equal chip budget
     # + spot decode-pool preemption resilience (slo_sim-backed).
     serve['disagg'] = bench_disagg()
+    # Fleet-scale simulation: real control plane, virtual replicas —
+    # pure CPU (runs after the device sections so its thousands of
+    # launch threads never race compiled-program HBM).
+    fleet = bench_fleet(seed=args.seed)
     # Flight-recorder overhead: ns/event + recorder-on vs -off
     # throughput on the identical workload (tracing is always-on in
     # production, so its cost is a headline, not a footnote).
@@ -876,6 +938,7 @@ def main() -> None:
             'train': train,
             'train_long_context_8k': train_8k,
             'serve': serve,
+            'fleet': fleet,
             'launch': launch,
             'baseline': 'reference Llama-3-8B PyTorch/XLA FSDP v6e-8 '
                         '= 2.225% MFU (examples/tpu/v6e/README.md:34-48)',

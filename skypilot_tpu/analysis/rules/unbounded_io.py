@@ -35,7 +35,11 @@ _SCOPE = ('provision/', 'jobs/', 'clouds/', 'backends/', 'data/',
           # HTTP — a handoff with no deadline wedges the REQUEST (and
           # its decode slot reservation) forever, exactly the failure
           # this rule exists for.
-          'inference/')
+          'inference/',
+          # The fleet simulator drives the real control plane in a
+          # tight tick loop — an unpaced retry or a deadline-less call
+          # there turns a 240 s simulated day into a hung process.
+          'fleetsim/')
 _REQUESTS_VERBS = ('get', 'post', 'put', 'delete', 'head', 'patch',
                    'request')
 _SUBPROCESS_BLOCKING = ('run', 'check_output', 'check_call', 'call')

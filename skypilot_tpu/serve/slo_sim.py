@@ -25,6 +25,7 @@ decision quality).
 from __future__ import annotations
 
 import math
+import random
 from typing import Dict, List, Optional, Tuple
 
 from skypilot_tpu.server import metrics as metrics_lib
@@ -305,6 +306,84 @@ DISAGG_TARGET_TPOT_MS = 12.0
 DISAGG_TOTAL_CHIPS = 8
 DISAGG_PEAK_QPS = 40.0
 DISAGG_TICK_S = 10.0
+
+# The canonical FLEET scenario (skypilot_tpu/fleetsim/), documented
+# next to its DISAGG_* siblings because bench_fleet, the fleetsim CLI
+# and the test suite must all describe the SAME experiment.  One
+# virtual replica here is deliberately SMALL (a single-host spot
+# decode engine, ~2 req/s at SLO) so the bench's diurnal peak of
+# roughly a thousand req/s genuinely needs a four-digit decode pool —
+# the point of the fleet simulator is control-plane behavior at a
+# replica count hardware quota won't allow, not latency fidelity of
+# any one replica.  Traffic: Poisson arrivals at FLEET_BASE_QPS
+# modulated by a sinusoidal diurnal envelope (amplitude
+# FLEET_DIURNAL_AMPLITUDE, period FLEET_DIURNAL_PERIOD_S — compressed
+# so a bench horizon of a few simulated minutes spans a full "day")
+# plus scripted burst multipliers; multi-turn sessions (geometric turn
+# count, exponential think time) over a large user population give
+# every turn a shared system prefix + its own history, so prefix-cache
+# hit rates EMERGE from the session structure.
+FLEET_COSTS = PhaseCosts(base_ttft_s=0.08, base_tpot_s=0.020,
+                         prefill_tok_per_s=9000.0,
+                         decode_tok_per_s=260.0, handoff_s=0.010)
+FLEET_PROMPT_TOKENS = 512.0     # mean NEW prompt tokens per turn
+FLEET_NEW_TOKENS = 96.0         # mean decoded tokens per turn
+FLEET_SHARED_PREFIX_TOKENS = 384.0   # system prompt, every session
+FLEET_TURN_HISTORY_TOKENS = 256.0    # per prior turn, same session
+FLEET_TARGET_TTFT_MS = 300.0
+FLEET_TARGET_TPOT_MS = 25.0
+FLEET_BASE_QPS = 1500.0         # diurnal mean arrival rate
+FLEET_DIURNAL_AMPLITUDE = 0.6   # peak = base * (1 + amplitude)
+FLEET_DIURNAL_PERIOD_S = 240.0  # one compressed "day" per bench run
+FLEET_MEAN_TURNS = 4.0          # geometric session length
+FLEET_MEAN_THINK_S = 8.0        # exponential inter-turn think time
+FLEET_USERS = 2_000_000         # user-id population sampled from
+FLEET_TICK_S = 1.0              # sim tick = LB/autoscaler cadence
+FLEET_SEED = 20260807           # default --seed for published numbers
+
+# Pool shape.  Prefill is a fixed-size pool (like the DISAGG scenario:
+# evaluate_pools gives prefill no QPS demand floor) sized for the
+# EFFECTIVE prompt-token peak — ~1.0k tokens/request after the emergent
+# prefix-cache hit rate, times the burst-on-diurnal-peak QPS — at just
+# under full utilization, so the token backlog (the LB shed signal)
+# only accumulates transiently.  Decode scales on the QPS demand floor
+# at FLEET_TARGET_QPS_PER_REPLICA plus TPOT violations, runs on spot
+# with FLEET_SPOT_HEADROOM extra replicas banked against preemption.
+FLEET_TARGET_QPS_PER_REPLICA = 2.0
+FLEET_PREFILL_REPLICAS = 400
+FLEET_DECODE_BASE_REPLICAS = 256
+FLEET_DECODE_MAX_REPLICAS = 2048
+FLEET_SPOT_HEADROOM = 64
+FLEET_MAX_QUEUE_TOKENS = 4000   # LB shed limit per prefill replica
+FLEET_PROVISION_DELAY_S = 8.0   # virtual replica launch -> READY
+FLEET_UPSCALE_DELAY_S = 1.0     # react within one decision tick
+FLEET_DOWNSCALE_DELAY_S = 30.0
+FLEET_LEASE_TTL_S = 5.0         # singleton-lease failover window
+
+# The canonical chaos script (Scenario.canonical): a 1.4x burst rides
+# the diurnal peak; mid-burst a storm preempts half the decode spot
+# pool; one second later the singleton-lease holder is killed (scaling
+# frozen until the TTL elapses and the survivor's CAS takeover lands);
+# on the decline one load balancer is severed for 20 s.
+FLEET_BURST_AT_S = 60.0
+FLEET_BURST_DURATION_S = 30.0
+FLEET_BURST_MULTIPLIER = 1.4
+FLEET_STORM_AT_S = 75.0
+FLEET_STORM_FRACTION = 0.5
+FLEET_KILL_AT_S = 76.0
+FLEET_SEVER_AT_S = 150.0
+FLEET_SEVER_DURATION_S = 20.0
+
+
+def make_rng(seed: Optional[int] = None) -> random.Random:
+    """The ONE seeded RNG shared by slo_sim and fleetsim.
+
+    Every stochastic choice in a fleet run (arrival thinning, session
+    turn counts, think times, storm victim sampling) draws from a
+    single ``random.Random`` minted here, plumbed from the CLI/bench
+    ``--seed`` flag — so every published fleet number is
+    byte-reproducible from its command line."""
+    return random.Random(FLEET_SEED if seed is None else seed)
 
 
 def disagg_ramp(plateau_ticks: int = 8) -> List[float]:

@@ -151,6 +151,23 @@ _HELP = {
     # ----- serve replicas --------------------------------------------------
     'skytpu_serve_replica_preemptions_total':
         'Serve replicas lost to preemption',
+    # ----- fleet simulator (fleetsim/) -------------------------------------
+    'skytpu_fleetsim_control_seconds':
+        'Wall time of one control-plane step inside a fleet '
+        'simulation, by path (lease.try_acquire / '
+        'autoscaler.evaluate / replicas.scale_up / lb.route / ...) — '
+        'with skytpu_db_op_seconds, the raw material of the per-run '
+        'hot-path profile report',
+    'skytpu_fleetsim_requests_total':
+        'Simulated requests by outcome (admitted / shed / no_ready / '
+        'retried) across the whole virtual fleet',
+    'skytpu_fleetsim_events_total':
+        'Scripted scenario events fired (preemption_storm / '
+        'leaseholder_kill / lb_severed / lb_restored)',
+    'skytpu_fleetsim_prefix_tokens_total':
+        'Cacheable prefix tokens by outcome (hit = served from a '
+        'replica\'s radix cache, miss = prefilled) — the emergent '
+        'prefix-cache hit rate of the simulated session traffic',
 }
 
 # Fixed bucket upper bounds per histogram family (seconds unless the
@@ -177,6 +194,12 @@ _BUCKETS: Dict[str, Tuple[float, ...]] = {
     'skytpu_train_step_seconds':
         (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
          60.0, 120.0),
+    # Control-plane steps in a fleet sim: same shape as db ops (they
+    # are mostly made OF db ops) with a longer tail for chunked
+    # thousand-replica scale-ups.
+    'skytpu_fleetsim_control_seconds':
+        (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+         0.5, 1.0, 2.5, 5.0, 10.0, 30.0),
 }
 
 # Family names referenced OUTSIDE the exporting process (the LB's

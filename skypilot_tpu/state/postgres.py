@@ -9,20 +9,31 @@ modules cannot tell the backends apart.
 
 psycopg is imported lazily inside the backend: deployments on the
 sqlite default (every agent VM, most dev laptops) never pay the import
-and never need the dependency installed.  Connections are cached
-per-thread per-URL, autocommit by default (reads never pin a
-transaction open); ``transaction()`` opens an explicit transaction
+and never need the dependency installed.  Connections come from a
+BOUNDED per-URL pool (size ``SKYTPU_DB_POOL_SIZE``, default 8) rather
+than one conn per thread: an N-worker API server — or the fleetsim's
+N-virtual-server scenario — otherwise opens one server connection per
+thread it ever runs a query on, and Postgres's max_connections is a
+fleet-global budget.  Conns are autocommit by default (reads never pin
+a transaction open); ``transaction()`` opens an explicit transaction
 block so multi-statement read-modify-write sections keep their sqlite
-semantics.
+semantics.  A thread re-entering the backend while it holds a pooled
+conn (a query inside a ``transaction()`` block) reuses that conn, so
+the pool can never self-deadlock on nested use.
 """
 from __future__ import annotations
 
 import contextlib
+import os
 import threading
-from typing import Any, Iterator, List, Optional, Sequence, Tuple
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
 from skypilot_tpu.state import dialect
 
+DEFAULT_POOL_SIZE = 8
+
+# Thread-local: the pooled conn this thread currently holds, per URL —
+# re-entrant use (query inside transaction()) must reuse it.
 _local = threading.local()
 
 
@@ -102,6 +113,79 @@ class _Conn:
         return _Cursor(self._pg.execute(translated, params), self._pg)
 
 
+def pool_size() -> int:
+    """Max server connections per URL for THIS process
+    (``SKYTPU_DB_POOL_SIZE``): Postgres's max_connections is a
+    fleet-global budget, so each API server caps its own draw."""
+    try:
+        return max(1, int(os.environ.get('SKYTPU_DB_POOL_SIZE',
+                                         DEFAULT_POOL_SIZE)))
+    except ValueError:
+        return DEFAULT_POOL_SIZE
+
+
+class _Pool:
+    """Bounded blocking connection pool for one URL.
+
+    Checkout returns an idle conn (discarding any the server closed)
+    or dials a new one while under the cap; at the cap, checkout
+    blocks until a conn is returned.  Connect happens OUTSIDE the
+    lock so a slow dial never serializes the whole pool."""
+
+    def __init__(self, psycopg_mod, url: str, size: int) -> None:
+        self._psycopg = psycopg_mod
+        self._url = url
+        self.size = size
+        self._cond = threading.Condition()
+        self._idle: List[Any] = []
+        self._total = 0
+
+    def checkout(self):
+        with self._cond:
+            while True:
+                while self._idle:
+                    conn = self._idle.pop()
+                    if getattr(conn, 'closed', False):
+                        self._total -= 1
+                        continue
+                    return conn
+                if self._total < self.size:
+                    self._total += 1
+                    break
+                self._cond.wait()
+        try:
+            conn = self._psycopg.connect(self._url,
+                                         row_factory=_row_factory)
+            conn.autocommit = True
+        except BaseException:
+            with self._cond:
+                self._total -= 1
+                self._cond.notify()
+            raise
+        return conn
+
+    def checkin(self, conn) -> None:
+        with self._cond:
+            if getattr(conn, 'closed', False):
+                self._total -= 1
+            else:
+                self._idle.append(conn)
+            self._cond.notify()
+
+    def close_idle(self) -> None:
+        with self._cond:
+            idle, self._idle = self._idle, []
+            self._total -= len(idle)
+            self._cond.notify_all()
+        for conn in idle:
+            with contextlib.suppress(Exception):
+                conn.close()
+
+
+_pools_lock = threading.Lock()
+_pools: Dict[str, _Pool] = {}
+
+
 class PostgresBackend:
     name = 'postgres'
 
@@ -119,24 +203,45 @@ class PostgresBackend:
         self._psycopg = psycopg
         self._url = url
 
-    def _connect(self):
-        conns = getattr(_local, 'pg_conns', None)
-        if conns is None:
-            conns = _local.pg_conns = {}
-        conn = conns.get(self._url)
-        if conn is None or conn.closed:
-            conn = self._psycopg.connect(self._url,
-                                         row_factory=_row_factory)
-            conn.autocommit = True
-            conns[self._url] = conn
-        return conn
+    def _pool(self) -> _Pool:
+        with _pools_lock:
+            pool = _pools.get(self._url)
+            if pool is None:
+                pool = _pools[self._url] = _Pool(
+                    self._psycopg, self._url, pool_size())
+            return pool
+
+    @contextlib.contextmanager
+    def _lease(self) -> Iterator[Any]:
+        """Borrow a pooled conn for the duration of one operation.
+
+        Re-entrant per thread: an operation issued while this thread
+        already holds a conn (query inside a transaction() block) runs
+        on the SAME conn — both for sqlite-parity semantics (the read
+        sees the open transaction's writes) and so nested use cannot
+        deadlock a fully-checked-out pool."""
+        held = getattr(_local, 'pg_held', None)
+        if held is None:
+            held = _local.pg_held = {}
+        conn = held.get(self._url)
+        if conn is not None and not getattr(conn, 'closed', False):
+            yield conn
+            return
+        pool = self._pool()
+        conn = pool.checkout()
+        held[self._url] = conn
+        try:
+            yield conn
+        finally:
+            del held[self._url]
+            pool.checkin(conn)
 
     # ----- the operation set ----------------------------------------------
     @contextlib.contextmanager
     def transaction(self) -> Iterator[_Conn]:
-        conn = self._connect()
-        with conn.transaction():
-            yield _Conn(conn)
+        with self._lease() as conn:
+            with conn.transaction():
+                yield _Conn(conn)
 
     def execute(self, sql: str, params: Tuple = ()) -> None:
         with self.transaction() as conn:
@@ -147,7 +252,8 @@ class PostgresBackend:
             return conn.execute(sql, params).rowcount
 
     def query(self, sql: str, params: Tuple = ()) -> List[Row]:
-        return _Conn(self._connect()).execute(sql, params).fetchall()
+        with self._lease() as conn:
+            return _Conn(conn).execute(sql, params).fetchall()
 
     def query_one(self, sql: str, params: Tuple = ()) -> Optional[Row]:
         rows = self.query(sql, params)
@@ -174,9 +280,14 @@ class PostgresBackend:
 
 
 def reset_connections_for_tests() -> None:
-    conns = getattr(_local, 'pg_conns', None)
-    if conns:
-        for conn in conns.values():
+    with _pools_lock:
+        pools = list(_pools.values())
+        _pools.clear()
+    for pool in pools:
+        pool.close_idle()
+    held = getattr(_local, 'pg_held', None)
+    if held:
+        for conn in held.values():
             with contextlib.suppress(Exception):
                 conn.close()
-        conns.clear()
+        held.clear()

@@ -1,0 +1,30 @@
+"""Known-bad twin for the fleetsim/ scope: a simulator helper that
+polls a replica endpoint with no deadline, hot-spins its retry, and
+exports fleet metrics nobody registered.  PARSED by
+tests/test_static_analysis.py, never imported."""
+import requests
+
+from skypilot_tpu.server import metrics as metrics_lib
+
+
+def probe_replica(url):
+    # BAD: no timeout= — one wedged virtual replica stalls the tick.
+    return requests.get(url + '/health')
+
+
+def wait_for_ready(url):
+    # BAD: while-True retry over a network call with no sleep/backoff
+    # and no deadline — a dead replica turns the sim into a hot spin.
+    while True:
+        resp = requests.get(url + '/health', timeout=1)
+        if resp.status_code == 200:
+            return resp
+
+
+def record_tick(dt_s):
+    # BAD: histogram name missing its unit suffix (_seconds).
+    metrics_lib.observe_hist('skytpu_fleetsim_tick_millis',
+                             dt_s * 1e3, path='tick')
+    # BAD: counter not registered in _HELP.
+    metrics_lib.inc_counter('skytpu_fleetsim_rogue_total',
+                            outcome='admitted')

@@ -1,0 +1,42 @@
+"""The full fleet storm against a live Postgres control plane.
+
+Marked ``slow``: the CI postgres-state job runs it explicitly (with a
+service container and psycopg installed); locally it needs
+SKYTPU_TEST_PG_URL.  This is where the acceptance criterion "the
+profile names the top-3 control-plane hot paths for a Postgres run"
+is held — the sqlite half lives in test_fleetsim.py.
+"""
+import dataclasses
+
+import pytest
+
+from pg_utils import needs_pg, pg_schema
+from skypilot_tpu.fleetsim import profile as fleet_profile
+from skypilot_tpu.fleetsim import sim as sim_lib
+
+pytestmark = [pytest.mark.slow, needs_pg]
+
+
+def test_postgres_fleet_storm_profiles_hot_paths():
+    with pg_schema('fleetsim') as url:
+        cfg = sim_lib.fleet_config(smoke=True, db=url)
+        # A touch more traffic than the sqlite smoke: every admission
+        # and state transition crosses a real network round trip, and
+        # the profile should show it.
+        cfg.traffic = dataclasses.replace(cfg.traffic, base_qps=96.0)
+        result = sim_lib.run_fleet(cfg)
+    from skypilot_tpu.utils import db_utils
+    db_utils.reset_connections_for_tests()   # schema is gone now
+    assert result.backend == 'postgres'
+    assert result.admitted > 1_000
+    assert result.storm_fraction_pct == 50.0
+    assert result.recovery_s is not None
+    assert result.lease_frozen_s == pytest.approx(cfg.lease_ttl_s)
+    paths = [row['path'] for row in result.profile]
+    assert any(p.startswith('db.') and p.endswith('[postgres]')
+               for p in paths), (
+        f'no postgres-backend ops in the profile: {paths[:6]}')
+    top3 = fleet_profile.top(result.profile)
+    assert len(top3) == 3, (
+        f'profile must rank the top-3 postgres control-plane hot '
+        f'paths, got {top3}')

@@ -116,14 +116,20 @@ def test_fixture_findings_land_where_expected():
     assert sum('psycopg' in f.message for f in db) == 2
     # unbounded-io: two missing timeouts + the hot retry loop in the
     # provisioning fixture, plus the KV-transfer twin (handoff push
-    # without timeout, hot handoff retry loop); the good file is clean.
+    # without timeout, hot handoff retry loop), plus the fleetsim twin
+    # (deadline-less replica probe, hot readiness retry); the good
+    # file is clean.
     ub = by_rule['unbounded-io']
     assert {f.path for f in ub} == {'provision/bad_unbounded.py',
-                                    'inference/bad_kv_transfer.py'}
-    assert sum('retry loop' in f.message for f in ub) == 2
+                                    'inference/bad_kv_transfer.py',
+                                    'fleetsim/bad_fleetsim.py'}
+    assert sum('retry loop' in f.message for f in ub) == 3
     kv = [f for f in ub if f.path == 'inference/bad_kv_transfer.py']
     assert len(kv) == 2
     assert any('session.post' in f.message for f in kv)
+    fleet = [f for f in ub if f.path == 'fleetsim/bad_fleetsim.py']
+    assert len(fleet) == 2
+    assert any('requests.get' in f.message for f in fleet)
     # metric-naming: _total / unit-suffix / legal-name / _HELP checks,
     # plus the span-registry half (legal dotted names, SPAN_HELP).
     mn = ' '.join(f.message for f in by_rule['metric-naming'])
@@ -152,6 +158,14 @@ def test_fixture_findings_land_where_expected():
     db_msgs = ' '.join(f.message for f in db_hits)
     assert 'skytpu_db_op_millis' in db_msgs
     assert 'skytpu_db_op_rogue_total' in db_msgs
+    # Fleetsim fixture: the new skytpu_fleetsim_* families are held to
+    # the same registry discipline (unit suffix, _HELP entry).
+    fleet_hits = [f for f in by_rule['metric-naming']
+                  if f.path == 'fleetsim/bad_fleetsim.py']
+    assert len(fleet_hits) == 3
+    fleet_msgs = ' '.join(f.message for f in fleet_hits)
+    assert 'skytpu_fleetsim_tick_millis' in fleet_msgs
+    assert 'skytpu_fleetsim_rogue_total' in fleet_msgs
 
 
 # ---------------------------------------------------------------------------
