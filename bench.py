@@ -130,8 +130,10 @@ _SERVE_BASELINE = {
     'n_chips': 8,
     'chip_hbm_gbps': 1640.0,           # v6e (Trillium) per chip
 }
-_HBM_GBPS = {'v5litepod': 819.0, 'v5e': 819.0, 'v6e': 1640.0,
-             'v5p': 2765.0, 'v4': 1228.0, 'cpu': 100.0}
+# Single source of truth for per-chip HBM bandwidth: the decode cost
+# model uses the same table for its roofline, and the perf gate
+# (skytpu perf) cross-checks bench output against it.
+from skypilot_tpu.perf.cost_model import HBM_GBPS as _HBM_GBPS  # noqa: E402
 
 
 def bench_serve(on_tpu: bool) -> dict:
@@ -222,6 +224,24 @@ def bench_serve(on_tpu: bool) -> dict:
     bw_base = base['out_tok_per_s'] / (base['chip_hbm_gbps'] *
                                        base['n_chips'])
     bw_ours = out_tok_per_s / _HBM_GBPS.get(kind, 100.0)
+    # Device-cost attribution: the SAME cost model that drives the
+    # engine's live skytpu_engine_mfu / _hbm_bytes_per_token gauges,
+    # evaluated at this run's measured saturated throughput.  `skytpu
+    # perf` asserts the live gauges agree with these within 5%.
+    cm = engine.perf_cost_model
+    mean_ctx = prompt_len + new_tokens / 2.0
+    n_active = min(n_slots, n_requests)
+    perf = {
+        'mfu_pct': round(cm.mfu(out_tok_per_s, mean_ctx), 6),
+        'hbm_bytes_per_token': round(
+            cm.decode_hbm_bytes_per_token(mean_ctx, n_active), 1),
+        'arith_intensity': round(
+            cm.arith_intensity(mean_ctx, n_active), 4),
+        'roofline_out_tok_per_s': round(
+            cm.roofline_decode_tokens_per_s(mean_ctx, n_active), 1),
+        'mean_context_len': mean_ctx,
+        'mean_occupancy': n_active,
+    }
     return {
         'model': 'llama2-7b' if on_tpu else 'tiny',
         'req_per_s': round(n_requests / wall, 2),
@@ -239,6 +259,7 @@ def bench_serve(on_tpu: bool) -> dict:
         'prompt_len': prompt_len,
         'new_tokens': new_tokens,
         'n_chips': 1,
+        'perf': perf,
         # Honest-scale comparisons vs the 8-chip v6e baseline:
         'vs_baseline_out_tok_per_chip': round(out_tok_per_s /
                                               per_chip_base, 2),

@@ -11,7 +11,11 @@ this rule asserts them for EVERY call site statically:
 - the family name is a legal Prometheus metric name;
 - it has a ``_HELP`` entry in server/metrics.py (central registry);
 - counters end ``_total``; gauges must NOT end ``_total``;
-  histogram/summary families end ``_seconds``/``_bytes``/``_ratio``.
+  histogram/summary families end ``_seconds``/``_bytes``/``_ratio``;
+- device-cost attribution suffixes (``_mfu``/``_per_token``/
+  ``_intensity`` — the perf/cost_model.py families) are gauge-only:
+  they name instantaneous modeled quantities, and exporting one as a
+  counter or histogram misleads every roofline consumer downstream.
 
 The flight recorder's span names (server/tracing.py) are the same kind
 of cross-process contract — the LB federates /debug views by span name
@@ -52,6 +56,9 @@ _KINDS = {
 }
 # Flight-recorder registration fns (span name = 2nd positional arg).
 _SPAN_FNS = ('record_span', 'record_instant')
+# Device-cost attribution suffixes (perf/cost_model.py): instantaneous
+# modeled ratios, legal only as gauges — see module docstring.
+_GAUGE_ONLY_SUFFIXES = ('_mfu', '_per_token', '_intensity')
 
 
 def _module_constants(tree: ast.AST) -> Dict[str, str]:
@@ -235,6 +242,13 @@ class MetricNamingRule(Rule):
                 self, module, node,
                 f'{kind} {name!r} must carry a unit suffix '
                 f'(_seconds/_bytes/_ratio)'))
+        if kind != 'gauge' and name.endswith(_GAUGE_ONLY_SUFFIXES):
+            out.append(project.finding(
+                self, module, node,
+                f'{kind} {name!r} carries a device-cost attribution '
+                f'suffix ({"/".join(_GAUGE_ONLY_SUFFIXES)}) — these '
+                f'are instantaneous modeled quantities, legal only '
+                f'as gauges'))
         if help_keys is not None and name not in help_keys:
             out.append(project.finding(
                 self, module, node,

@@ -383,6 +383,110 @@ def trace_cmd(request_id, endpoint, chrome_out):
                    f'(load in ui.perfetto.dev)')
 
 
+@cli.command('profile')
+@click.argument('endpoint')
+@click.option('--duration-ms', default=500.0, show_default=True,
+              help='Capture window per replica (bounded server-side).')
+@click.option('--out', type=click.Path(), default=None,
+              help='Download the Perfetto artifact to this path '
+                   '(single-replica endpoints only).')
+def profile_cmd(endpoint, duration_ms, out):
+    """Trigger an on-demand device profiler capture and summarize it.
+
+    ENDPOINT is an inference server base URL or a service load
+    balancer (which federates: every ready replica captures
+    concurrently).  Each capture runs jax.profiler for the requested
+    window and leaves a Perfetto trace in a retention-bounded store
+    (knobs SKYTPU_PROFILE_RETAIN / SKYTPU_PROFILE_DIR); artifacts are
+    downloadable from /debug/profile/artifact/<path> while retained.
+    """
+    import json as json_lib
+    import urllib.error
+    import urllib.parse
+    import urllib.request
+
+    base = endpoint.rstrip('/')
+    url = (f'{base}/debug/profile?duration_ms='
+           f'{urllib.parse.quote(str(duration_ms), safe="")}')
+    try:
+        with urllib.request.urlopen(
+                url, timeout=duration_ms / 1e3 + 30) as resp:
+            doc = json_lib.load(resp)
+    except urllib.error.HTTPError as e:
+        try:
+            detail = json_lib.load(e).get('error', '')
+        except Exception:  # noqa: BLE001 - best-effort error body
+            detail = ''
+        raise click.ClickException(
+            f'{base}/debug/profile: HTTP {e.code}'
+            + (f' — {detail}' if detail else ''))
+    except (urllib.error.URLError, OSError) as e:
+        raise click.ClickException(f'cannot reach {base}: {e}')
+
+    captures = doc.get('captures', [doc])   # LB federates; replica: one
+    rows = []
+    for c in captures:
+        rows.append([
+            str(c.get('replica', c.get('role', '-'))),
+            'ok' if c.get('ok', True) else 'FAILED',
+            c.get('name', '-'),
+            '-' if c.get('duration_ms') is None
+            else f'{c["duration_ms"]:.0f}',
+            '-' if c.get('size_bytes') is None
+            else f'{c["size_bytes"]}',
+            str(c.get('artifact', '-')),
+        ])
+    ux_utils.print_table(
+        ['REPLICA', 'STATUS', 'CAPTURE', 'DUR_MS', 'BYTES', 'ARTIFACT'],
+        rows)
+    if out:
+        ok = [c for c in captures
+              if c.get('ok', True) and c.get('artifact')]
+        if len(ok) != 1:
+            raise click.ClickException(
+                '--out needs exactly one successful capture with an '
+                f'artifact (got {len(ok)}); fetch per-replica '
+                'endpoints directly for multi-replica services')
+        art = urllib.parse.quote(ok[0]['artifact'])
+        art_base = ok[0].get('url', base).rstrip('/')
+        with urllib.request.urlopen(
+                f'{art_base}/debug/profile/artifact/{art}',
+                timeout=30) as resp, open(out, 'wb') as f:
+            f.write(resp.read())
+        click.echo(f'artifact written to {out} '
+                   f'(open in ui.perfetto.dev)')
+
+
+@cli.command('perf')
+@click.option('--check', 'check_flag', is_flag=True,
+              help='Exit non-zero if any regression check fails '
+                   '(the CI perf-gate mode).')
+@click.option('--baseline', type=click.Path(exists=True), default=None,
+              help='Benchmark baseline JSON (default: the latest '
+                   'BENCH_*.json in the repo root).')
+@click.option('--as-json', is_flag=True, help='Emit the raw report.')
+def perf_cmd(check_flag, baseline, as_json):
+    """Perf-regression gate: fresh probe vs the committed baseline.
+
+    Runs a short in-process serve probe (tiny model — runs anywhere,
+    including CPU CI), checks the live MFU / bytes-per-token gauges
+    agree with the cost model within tolerance, compares throughput
+    against the latest BENCH_*.json within declared tolerances
+    (cross-hardware comparisons are skipped, not failed), and renders
+    a per-prefill-bucket observed-vs-roofline report.
+    """
+    import json as json_lib
+
+    from skypilot_tpu.perf import gate as gate_lib
+    report = gate_lib.run(baseline_path=baseline)
+    if as_json:
+        click.echo(json_lib.dumps(report, indent=2, sort_keys=True))
+    else:
+        click.echo(gate_lib.render_report(report), nl=False)
+    if check_flag and not report['ok']:
+        raise SystemExit(1)
+
+
 @cli.command('rotate-keys')
 def rotate_keys():
     """Rotate the framework SSH keypair across every UP cluster.

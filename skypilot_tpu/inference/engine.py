@@ -106,6 +106,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import os
 import queue
 import threading
 import time
@@ -117,6 +118,8 @@ import numpy as np
 
 from skypilot_tpu import sky_logging
 from skypilot_tpu.inference.paging import TRASH_PAGE, PagePool, RadixCache
+from skypilot_tpu.perf import compile_telemetry
+from skypilot_tpu.perf import cost_model as cost_model_lib
 from skypilot_tpu.server import metrics as metrics_lib
 from skypilot_tpu.server import tracing
 
@@ -356,6 +359,22 @@ class DecodeEngine:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._last_gauges: Optional[tuple] = None
+        # Device-cost attribution (perf/): the static cost model is
+        # built once the cache exists (dtype of the page pool is an
+        # input); the loop thread folds these host-side accumulators
+        # into the live MFU / bytes-per-token gauges — token count,
+        # token-weighted context length and token-weighted batch
+        # occupancy, all written by _process_rows.
+        self._cost_model: Optional[cost_model_lib.EngineCostModel] = None
+        self._perf_tokens = 0
+        self._perf_ctx_sum = 0
+        self._perf_occ_sum = 0
+        self._perf_window: Optional[tuple] = None
+        self._perf_last: Optional[dict] = None
+        # Minimum attribution window; benchmarks/tests shrink or grow
+        # it to bracket exactly their measured region.
+        self.perf_window_s = float(
+            os.environ.get('SKYTPU_PERF_WINDOW_S', '0.5'))
         self.error: Optional[BaseException] = None
         self._fmt_params = None
         self._prefill_compiled: Dict[tuple, Any] = {}
@@ -389,10 +408,52 @@ class DecodeEngine:
                 logger.exception('param layout optimization failed; '
                                  'serving with default layouts')
                 self._fmt_params = None
+        # Cost model + compile telemetry.  from_engine_state reads only
+        # leaf METADATA (shape/dtype — the page pool's dtype is how a
+        # future int8 KV cache lands as a measured bytes/token halving),
+        # never values: no device sync.  install() is idempotent and
+        # process-global.
+        compile_telemetry.install()
+        self._cost_model = cost_model_lib.EngineCostModel.from_engine_state(
+            self.model.cfg, jax.tree_util.tree_leaves(self.params),
+            jax.tree_util.tree_leaves(self._cache),
+            n_chips=self._mesh.size if self._mesh is not None else 1)
 
     @property
     def healthy(self) -> bool:
         return self.error is None
+
+    @property
+    def perf_cost_model(self) -> Optional[cost_model_lib.EngineCostModel]:
+        """The static per-dispatch cost model behind the live gauges."""
+        return self._cost_model
+
+    def perf_snapshot(self) -> Optional[dict]:
+        """Last perf-gauge sample the loop thread computed (mfu,
+        hbm_bytes_per_token, arith_intensity, tokens_per_s,
+        mean_context, mean_occupancy) — None until the first non-idle
+        attribution window closes."""
+        return dict(self._perf_last) if self._perf_last else None
+
+    def perf_reset_window(self) -> None:
+        """Restart the attribution window so the next sample covers
+        only what follows (benchmarks bracket their measured region
+        with this).  The start is stamped HERE, not lazily at the next
+        loop sample: step()'s sample point sits after _admit_free, so a
+        lazy stamp would exclude the first admission's prefill dispatch
+        from the window while any wall-clock bracket around the region
+        includes it — a systematic rate skew on short regions."""
+        self._perf_window = (time.perf_counter(), self._perf_tokens,
+                             self._perf_ctx_sum, self._perf_occ_sum)
+
+    def arm_recompile_sentinel(self) -> None:
+        """Declare warmup complete: every XLA compile from here on
+        records a perf.recompile flight-recorder event, and
+        SKYTPU_STRICT_RECOMPILE=1 escalates it to a hard failure in the
+        compiling call.  prewarm() arms automatically on the paths that
+        actually compile the shape set; lazy-compile callers (CPU
+        tests) opt in here once their shapes are warm."""
+        compile_telemetry.arm()
 
     @staticmethod
     def _validate_paging(config: EngineConfig, max_len: int) -> None:
@@ -1337,8 +1398,14 @@ class DecodeEngine:
         """
         if self._mesh is not None:
             self._prewarm_mesh()
+            compile_telemetry.arm()
             return
         if self._fmt_params is None:
+            # Lazy-compile path (no TPU layout pass): nothing was
+            # compiled here, so arming the recompile sentinel would
+            # flag the first LEGITIMATE compiles.  Callers that warm
+            # their shapes by running them opt in via
+            # arm_recompile_sentinel().
             return
         # Include the first power of two >= n_slots: _admit_group pads to
         # the NEXT power of two, which exceeds n_slots when n_slots is not
@@ -1353,6 +1420,10 @@ class DecodeEngine:
             self._chunk_for(self.cfg.prefill_buckets[-1])
             for bucket in self.cfg.prefill_buckets:
                 self._chunk_insert_for(bucket)
+        # The full admissible shape set is compiled: any compile after
+        # this point is a mid-traffic stall — arm the runtime sentinel
+        # (the twin of the static recompile-hazard rule).
+        compile_telemetry.arm()
 
     def _chunking_possible(self) -> bool:
         """True when an admissible prompt can exceed the largest bucket
@@ -2070,9 +2141,57 @@ class DecodeEngine:
                                 float(done))
         return True
 
+    def _sample_perf(self, n_active: int) -> None:
+        """Loop-thread device-cost gauges (perf/cost_model.py): pure
+        host arithmetic over _process_rows' emit accumulators — no
+        device state is touched, so attribution adds ZERO syncs
+        (test-enforced).  Windowed at perf_window_s so the idle 1 kHz
+        loop does not recompute rates every millisecond."""
+        cm = self._cost_model
+        if cm is None:
+            return
+        now = time.perf_counter()
+        if self._perf_window is None:
+            self._perf_window = (now, self._perf_tokens,
+                                 self._perf_ctx_sum, self._perf_occ_sum)
+            return
+        t0, tok0, ctx0, occ0 = self._perf_window
+        if now - t0 < self.perf_window_s:
+            return
+        d_tok = self._perf_tokens - tok0
+        self._perf_window = (now, self._perf_tokens, self._perf_ctx_sum,
+                             self._perf_occ_sum)
+        if d_tok <= 0:
+            # Idle window: utilization is genuinely zero; the modeled
+            # bytes/intensity gauges keep their last value (they
+            # describe the workload shape, not the rate).
+            if self._perf_last is not None and self._perf_last['mfu']:
+                self._perf_last = dict(self._perf_last, mfu=0.0)
+                metrics_lib.set_gauge('skytpu_engine_mfu', 0.0)
+            return
+        rate = d_tok / (now - t0)
+        # Token-weighted means over the window: each emitted token
+        # contributed its slot's context length and its decode call's
+        # batch size.
+        mean_ctx = (self._perf_ctx_sum - ctx0) / d_tok
+        mean_occ = max(1.0, (self._perf_occ_sum - occ0) / d_tok)
+        mfu = cm.mfu(rate, mean_ctx)
+        hbm_bytes = cm.decode_hbm_bytes_per_token(mean_ctx, mean_occ)
+        intensity = cm.arith_intensity(mean_ctx, mean_occ)
+        self._perf_last = {
+            'mfu': mfu, 'hbm_bytes_per_token': hbm_bytes,
+            'arith_intensity': intensity, 'tokens_per_s': rate,
+            'mean_context': mean_ctx, 'mean_occupancy': mean_occ,
+        }
+        metrics_lib.set_gauge('skytpu_engine_mfu', mfu)
+        metrics_lib.set_gauge('skytpu_engine_hbm_bytes_per_token',
+                              hbm_bytes)
+        metrics_lib.set_gauge('skytpu_engine_arith_intensity', intensity)
+
     def _sample_gauges(self, n_active: int) -> None:
         """Loop-thread occupancy/queue gauges; skipped when unchanged so
         the idle 1 kHz loop does not hammer the registry lock."""
+        self._sample_perf(n_active)
         sample = (n_active,
                   self._prefill_q.qsize() + self._long_q.qsize() +
                   len(self._ready_q) + len(self._hit_q) +
@@ -2226,6 +2345,12 @@ class DecodeEngine:
             for t in range(start, out.shape[0]):
                 tok = int(out[t, i])
                 slot.length += 1
+                # Device-cost attribution: this token's context length
+                # and decode-batch size (token-weighted accumulators
+                # _sample_perf folds into the live gauges).
+                self._perf_tokens += 1
+                self._perf_ctx_sum += slot.length
+                self._perf_occ_sum += len(snapshot)
                 if slot.pages is not None:
                     # Retire donates prompt+generated pages to the
                     # prefix cache (it needs the generated token ids)

@@ -1,0 +1,105 @@
+"""XLA compile telemetry + the runtime recompile sentinel.
+
+`install()` hooks `jax.monitoring`'s event-duration stream: every XLA
+backend compile in the process increments
+`skytpu_engine_xla_compile_total` and lands in the
+`skytpu_engine_xla_compile_seconds` histogram — compile time becomes a
+first-class scrapeable quantity instead of a mystery TTFT spike.
+
+The SENTINEL is the runtime twin of the static `recompile-hazard`
+rule: once `arm()` is called (the engine arms it when `prewarm()` has
+actually compiled the shape set), every further compile is a
+mid-traffic stall by definition.  Each one records a flight-recorder
+instant event (`perf.recompile`, rid `recompile-sentinel` — visible in
+/debug/requests) carrying the traced input shapes, recovered
+best-effort from the compiling frame.  `SKYTPU_STRICT_RECOMPILE=1`
+escalates to a hard RuntimeError raised INSIDE the offending jit call,
+so the failure lands on the code path that introduced the unpinned
+shape, not in a log nobody reads.
+
+The listener is process-global (jax.monitoring has no unregister), so
+arming is a plain flag: `disarm()` / `reset_for_tests()` return the
+process to record-only mode.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+
+from skypilot_tpu.server import metrics as metrics_lib
+from skypilot_tpu.server import tracing
+
+_COMPILE_EVENT = '/jax/core/compile/backend_compile_duration'
+# Flight-recorder request id the sentinel events land under: a fixed,
+# grep-able id so `/debug/requests` and `skytpu trace
+# recompile-sentinel` surface every post-warmup compile in one place.
+SENTINEL_REQUEST_ID = 'recompile-sentinel'
+STRICT_ENV = 'SKYTPU_STRICT_RECOMPILE'
+
+_LOCK = threading.Lock()
+_STATE = {'installed': False, 'armed': False}
+
+
+def _traced_shapes() -> str:
+    """Best-effort recovery of the shapes being compiled: walk the
+    stack for jax's lowering frame (pxla) holding the input avals.
+    Internal-layout dependent, so failures degrade to 'unknown'."""
+    try:
+        frame = sys._getframe()  # pylint: disable=protected-access
+        while frame is not None:
+            if ('pxla' in frame.f_code.co_filename and
+                    'global_in_avals' in frame.f_locals):
+                return str(list(frame.f_locals['global_in_avals']))
+            frame = frame.f_back
+    except Exception:  # pylint: disable=broad-except
+        pass
+    return 'unknown'
+
+
+def _listener(event: str, duration_secs: float, **_kwargs) -> None:
+    if event != _COMPILE_EVENT:
+        return
+    metrics_lib.inc_counter('skytpu_engine_xla_compile_total')
+    metrics_lib.observe_hist('skytpu_engine_xla_compile_seconds',
+                             float(duration_secs))
+    if not _STATE['armed']:
+        return
+    shapes = _traced_shapes()
+    tracing.record_instant(SENTINEL_REQUEST_ID, 'perf.recompile',
+                           compile_seconds=round(float(duration_secs), 4),
+                           shapes=shapes)
+    if os.environ.get(STRICT_ENV, '') == '1':
+        raise RuntimeError(
+            f'post-warmup XLA recompile (traced shapes: {shapes}): the '
+            f'engine was prewarmed, so this compile stalls live traffic. '
+            f'Pin the offending shape (prefill buckets / padded admission '
+            f'sizes — see the static recompile-hazard rule) or unset '
+            f'{STRICT_ENV} to record-only mode.')
+
+
+def install() -> None:
+    """Register the jax.monitoring listener once per process."""
+    with _LOCK:
+        if _STATE['installed']:
+            return
+        import jax.monitoring as monitoring  # defer jax import
+        monitoring.register_event_duration_secs_listener(_listener)
+        _STATE['installed'] = True
+
+
+def arm() -> None:
+    """Declare warmup complete: compiles from here on are hazards."""
+    _STATE['armed'] = True
+
+
+def disarm() -> None:
+    _STATE['armed'] = False
+
+
+def armed() -> bool:
+    return _STATE['armed']
+
+
+def reset_for_tests() -> None:
+    _STATE['armed'] = False

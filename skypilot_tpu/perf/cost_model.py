@@ -1,0 +1,140 @@
+"""Static per-dispatch device-cost model for the decode engine.
+
+Everything here is derived from quantities the HOST already knows —
+model config, weight-tree byte size, KV-cache element width, batch
+occupancy, mean context length — so the engine loop can attribute
+FLOPs and HBM bytes to every decoded token without touching the
+device.  The conventions match `train/flops.py` (2N forward dense
+FLOPs per token; the trainer's 6N is the fwd+bwd triple), so the live
+`skytpu_engine_mfu` gauge, `bench.py` and the trainer's
+`skytpu_train_mfu_percent` all report the same quantity.
+
+The bytes side is the decode roofline: each decode step streams the
+full weight tree once (amortized over the active batch) and reads the
+KV history of every active sequence.  The KV term scales with the
+CACHE ELEMENT WIDTH — the page pool's dtype is an input, so a future
+int8 KV cache shows up as a measured bytes/token halving, not a
+recalibration.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+from skypilot_tpu.train import flops as flops_lib
+
+# Per-chip HBM bandwidth, GB/s (same table bench.py's per-bandwidth
+# baseline comparison uses; 'cpu' is nominal so accounting runs
+# anywhere, same convention as PEAK_BF16_TFLOPS['cpu']).
+HBM_GBPS = {
+    'v5litepod': 819.0,
+    'v5e': 819.0,
+    'v6e': 1640.0,
+    'v5p': 2765.0,
+    'v4': 1228.0,
+    'cpu': 100.0,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineCostModel:
+    """Per-dispatch FLOP/byte attribution for one decode engine.
+
+    Frozen: every field is static for the engine's lifetime (weights
+    and cache geometry are fixed at construction), so the loop-thread
+    evaluations below are pure arithmetic on python scalars.
+    """
+    n_params: int           # model parameters (embeddings included)
+    n_layers: int
+    dim: int
+    n_kv_heads: int
+    head_dim: int
+    param_bytes: int        # total bytes of the installed weight tree
+    kv_dtype_bytes: int     # element width of the KV cache / page pool
+    n_chips: int = 1
+    chip: str = 'cpu'
+
+    @classmethod
+    def from_engine_state(cls, cfg, param_leaves: Sequence,
+                          cache_leaves: Sequence, n_chips: int = 1,
+                          chip: Optional[str] = None) -> 'EngineCostModel':
+        """Build from live engine state.  Reads only leaf METADATA
+        (shape/dtype) — never leaf values, so no device sync."""
+        param_bytes = sum(l.size * l.dtype.itemsize for l in param_leaves)
+        kv_bytes = (cache_leaves[0].dtype.itemsize if cache_leaves
+                    else 2)
+        return cls(n_params=cfg.num_params(), n_layers=cfg.n_layers,
+                   dim=cfg.dim, n_kv_heads=cfg.n_kv_heads,
+                   head_dim=cfg.head_dim, param_bytes=int(param_bytes),
+                   kv_dtype_bytes=int(kv_bytes), n_chips=n_chips,
+                   chip=chip or flops_lib.chip_kind())
+
+    # ----- FLOPs -----------------------------------------------------
+    def decode_flops_per_token(self, context_len: float) -> float:
+        """Forward model FLOPs to decode one token at the given KV
+        context length: 2N dense + the causal-attention term (the
+        forward third of flops_lib.train_flops_per_token's 6N+6LSD)."""
+        return 2.0 * self.n_params + \
+            2.0 * self.n_layers * context_len * self.dim
+
+    # ----- HBM bytes -------------------------------------------------
+    def kv_bytes_per_pos(self) -> float:
+        """Bytes of K+V held per token position across all layers."""
+        return (2.0 * self.n_layers * self.n_kv_heads * self.head_dim *
+                self.kv_dtype_bytes)
+
+    def decode_hbm_bytes_per_token(self, context_len: float,
+                                   n_active: int) -> float:
+        """HBM traffic attributed to one decoded token: the weight
+        stream (read once per step, amortized over the batch) plus
+        this sequence's KV history read and its one-position write."""
+        weights = self.param_bytes / max(1, n_active)
+        kv_read = self.kv_bytes_per_pos() * context_len
+        kv_write = self.kv_bytes_per_pos()
+        return weights + kv_read + kv_write
+
+    def arith_intensity(self, context_len: float, n_active: int) -> float:
+        """FLOPs per HBM byte at the given occupancy — distance from
+        the chip's roofline ridge point."""
+        return (self.decode_flops_per_token(context_len) /
+                self.decode_hbm_bytes_per_token(context_len, n_active))
+
+    # ----- roofline --------------------------------------------------
+    def _peaks(self):
+        peak_flops = (flops_lib.PEAK_BF16_TFLOPS.get(self.chip, 0.0) *
+                      1e12 * self.n_chips)
+        hbm_bytes_s = HBM_GBPS.get(self.chip, 0.0) * 1e9 * self.n_chips
+        return peak_flops, hbm_bytes_s
+
+    def mfu(self, tokens_per_s: float, context_len: float) -> float:
+        """Achieved decode model FLOPs as % of the slice's peak."""
+        peak_flops, _ = self._peaks()
+        if peak_flops <= 0 or tokens_per_s <= 0:
+            return 0.0
+        return (100.0 * tokens_per_s *
+                self.decode_flops_per_token(context_len) / peak_flops)
+
+    def roofline_decode_tokens_per_s(self, context_len: float,
+                                     n_active: int) -> float:
+        """Decode-throughput ceiling at this occupancy: the lower of
+        the compute-bound and bandwidth-bound token rates."""
+        peak_flops, hbm = self._peaks()
+        if peak_flops <= 0 or hbm <= 0:
+            return 0.0
+        compute_bound = peak_flops / self.decode_flops_per_token(
+            context_len)
+        bw_bound = hbm / self.decode_hbm_bytes_per_token(context_len,
+                                                         n_active)
+        return min(compute_bound, bw_bound)
+
+    def prefill_seconds(self, bucket: int) -> float:
+        """Roofline lower bound for one prefill dispatch of `bucket`
+        tokens: dense FLOPs over every prompt token (mean attention
+        context bucket/2) vs one weight stream + the KV write."""
+        peak_flops, hbm = self._peaks()
+        if peak_flops <= 0 or hbm <= 0:
+            return 0.0
+        fl = bucket * (2.0 * self.n_params +
+                       2.0 * self.n_layers * (bucket / 2.0) * self.dim)
+        by = self.param_bytes + self.kv_bytes_per_pos() * bucket
+        return max(fl / peak_flops, by / hbm)

@@ -1,0 +1,145 @@
+"""On-demand jax.profiler capture with bounded on-disk retention.
+
+Backs the inference server's `GET /debug/profile?duration_ms=` route:
+start `jax.profiler`, hold the window open, stop, and hand back a
+Perfetto-compatible artifact (`perfetto_trace.json.gz`) living under a
+retention-bounded directory.  Long-lived replicas must not grow disk
+without bound, so the store keeps the newest `SKYTPU_PROFILE_RETAIN`
+captures (default 4), prunes the rest after every capture, and
+`cleanup()` — wired to the server's shutdown — removes everything the
+store created (including its own tmpdir when no SKYTPU_PROFILE_DIR
+was given).
+
+jax's profiler is process-global, so captures are serialized behind a
+non-blocking lock: a second concurrent request gets a CaptureBusy
+(the HTTP layer maps it to 409) instead of corrupting the trace.
+"""
+from __future__ import annotations
+
+import os
+import pathlib
+import shutil
+import threading
+import time
+from typing import List, Optional
+
+from skypilot_tpu.server import metrics as metrics_lib
+from skypilot_tpu.server import tracing
+
+RETAIN_ENV = 'SKYTPU_PROFILE_RETAIN'
+DIR_ENV = 'SKYTPU_PROFILE_DIR'
+# Upper bound on one capture window: /debug/profile is a debugging
+# endpoint, not a long-running recorder.
+MAX_CAPTURE_MS = 60_000.0
+
+
+class CaptureBusy(RuntimeError):
+    """A capture is already holding the (process-global) profiler."""
+
+
+class ProfileStore:
+    """Retention-bounded home for /debug/profile artifacts."""
+
+    def __init__(self, root: Optional[str] = None,
+                 retain: Optional[int] = None) -> None:
+        env_root = root or os.environ.get(DIR_ENV)
+        # Created-by-us tmpdirs are removed wholesale at cleanup();
+        # a user-supplied dir only has our capture-* children removed.
+        self._owns_root = env_root is None
+        if env_root is None:
+            import tempfile
+            env_root = tempfile.mkdtemp(prefix='skytpu-profile-')
+        self._root = pathlib.Path(env_root)
+        self._root.mkdir(parents=True, exist_ok=True)
+        self._retain = max(1, int(retain if retain is not None else
+                                  os.environ.get(RETAIN_ENV, '4')))
+        self._lock = threading.Lock()
+        self._seq = 0
+
+    @property
+    def root(self) -> pathlib.Path:
+        return self._root
+
+    def captures(self) -> List[str]:
+        """Capture dir names, oldest first (sortable sequence names)."""
+        return sorted(p.name for p in self._root.glob('capture-*')
+                      if p.is_dir())
+
+    def capture(self, duration_ms: float,
+                request_id: Optional[str] = None) -> dict:
+        """Run one profiler window; returns the artifact summary.
+
+        Runs on an executor thread (it sleeps for the window), never on
+        the event loop or the engine loop.
+        """
+        duration_ms = min(float(duration_ms), MAX_CAPTURE_MS)
+        if duration_ms <= 0:
+            raise ValueError(f'duration_ms must be positive, '
+                             f'got {duration_ms}')
+        if not self._lock.acquire(blocking=False):
+            raise CaptureBusy('a profiler capture is already in progress '
+                              '(jax.profiler is process-global)')
+        try:
+            import jax
+            self._seq += 1
+            name = f'capture-{self._seq:06d}'
+            out = self._root / name
+            out.mkdir(parents=True, exist_ok=True)
+            t0 = time.perf_counter()
+            jax.profiler.start_trace(str(out), create_perfetto_trace=True)
+            try:
+                time.sleep(duration_ms / 1e3)
+            finally:
+                jax.profiler.stop_trace()
+            t1 = time.perf_counter()
+            artifact = self._find_perfetto(out)
+            rel = str(artifact.relative_to(self._root)) if artifact else None
+            size = artifact.stat().st_size if artifact else 0
+            metrics_lib.inc_counter('skytpu_profile_captures_total')
+            tracing.record_span(request_id, 'perf.profile_capture',
+                                t0, t1, artifact=rel or 'missing',
+                                size_bytes=size)
+            self._prune()
+            return {
+                'name': name,
+                'duration_ms': round((t1 - t0) * 1e3, 1),
+                'artifact': rel,
+                'size_bytes': size,
+                'retained': self.captures(),
+            }
+        finally:
+            self._lock.release()
+
+    def artifact_path(self, rel: str) -> pathlib.Path:
+        """Resolve an artifact path, refusing traversal out of root."""
+        path = (self._root / rel).resolve()
+        if not str(path).startswith(str(self._root.resolve()) + os.sep):
+            raise ValueError(f'artifact path escapes the profile dir: '
+                             f'{rel!r}')
+        if not path.is_file():
+            raise FileNotFoundError(rel)
+        return path
+
+    @staticmethod
+    def _find_perfetto(capture_dir: pathlib.Path
+                       ) -> Optional[pathlib.Path]:
+        hits = sorted(capture_dir.rglob('perfetto_trace.json.gz'))
+        if hits:
+            return hits[0]
+        # Older jax fallback: the chrome-trace artifact is still
+        # Perfetto-loadable.
+        hits = sorted(capture_dir.rglob('*.trace.json.gz'))
+        return hits[0] if hits else None
+
+    def _prune(self) -> None:
+        names = self.captures()
+        for name in names[:-self._retain]:
+            shutil.rmtree(self._root / name, ignore_errors=True)
+
+    def cleanup(self) -> None:
+        """Shutdown hook: leave NOTHING behind on long-lived hosts."""
+        if self._owns_root:
+            shutil.rmtree(self._root, ignore_errors=True)
+            return
+        for name in self.captures():
+            shutil.rmtree(self._root / name, ignore_errors=True)

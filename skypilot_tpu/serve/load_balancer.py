@@ -627,15 +627,15 @@ class LoadBalancer:
             content_type='text/plain')
 
     # ----- flight-recorder federation -----------------------------------------
-    async def _fetch_debug_json(self, url: str, path: str):
+    async def _fetch_debug_json(self, url: str, path: str,
+                                timeout: float = _FEDERATE_TIMEOUT_SECONDS):
         """GET one replica's /debug endpoint; None on any failure (a
         dead replica must not fail the federated view)."""
         try:
             assert self._session is not None
             async with self._session.get(
                     url.rstrip('/') + path,
-                    timeout=aiohttp.ClientTimeout(
-                        total=_FEDERATE_TIMEOUT_SECONDS)) as resp:
+                    timeout=aiohttp.ClientTimeout(total=timeout)) as resp:
                 if resp.status == 200:
                     return await resp.json()
         except (aiohttp.ClientError, asyncio.TimeoutError, OSError,
@@ -676,6 +676,37 @@ class LoadBalancer:
                      reverse=True)
         return web.json_response({'service': self.service_name,
                                   'requests': out})
+
+    async def _debug_profile(self, request: web.Request) -> web.Response:
+        """Federated on-demand profiler capture: trigger /debug/profile
+        on every ready replica concurrently and return the per-replica
+        capture summaries.  The fetch timeout is extended past the
+        requested capture window (the replica holds the request open
+        for the whole duration); a replica mid-capture (409) or dark
+        reports as failed without failing the rest."""
+        duration_ms = request.query.get('duration_ms', '500')
+        try:
+            timeout = (float(duration_ms) / 1e3 +
+                       _FEDERATE_TIMEOUT_SECONDS)
+        except ValueError:
+            return web.json_response(
+                {'error': 'duration_ms must be a number'}, status=400)
+        replicas = self._replica_pairs()
+        quoted = urllib.parse.quote(duration_ms, safe='')
+        docs = await asyncio.gather(
+            *(self._fetch_debug_json(
+                url, f'/debug/profile?duration_ms={quoted}',
+                timeout=timeout)
+              for _, url in replicas))
+        out = []
+        for (rid_label, url), doc in zip(replicas, docs):
+            if doc is None:
+                out.append({'replica': str(rid_label), 'ok': False})
+            else:
+                out.append({'replica': str(rid_label), 'ok': True,
+                            'url': url, **doc})
+        return web.json_response({'service': self.service_name,
+                                  'captures': out})
 
     async def _debug_request(self, request: web.Request) -> web.Response:
         """Federated per-request trace: the LB's own span events
@@ -727,6 +758,7 @@ class LoadBalancer:
             app.router.add_get('/debug/requests', self._debug_requests)
             app.router.add_get('/debug/requests/{request_id}',
                                self._debug_request)
+            app.router.add_get('/debug/profile', self._debug_profile)
             app.router.add_route('*', '/{tail:.*}', self._handle)
             runner = web.AppRunner(app)
             await runner.setup()

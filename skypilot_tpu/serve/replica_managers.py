@@ -12,7 +12,9 @@ let the autoscaler's next tick replace it.
 """
 from __future__ import annotations
 
+import os
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 import requests as requests_lib
@@ -36,6 +38,13 @@ logger = sky_logging.init_logger(__name__)
 _NOT_READY_THRESHOLD = 3
 # Consecutive probe failures before a NOT_READY replica is replaced.
 _REPLACE_THRESHOLD = 12
+# TTL backstop for the cached ready view: serve_state's mutation
+# counter invalidates exactly for same-process writes, but a writer in
+# ANOTHER process (Postgres control plane, a second controller) is
+# invisible to it, so a cached view is additionally re-queried after
+# this many seconds.  0 disables caching outright.
+_READY_VIEW_TTL_S = float(os.environ.get('SKYTPU_READY_VIEW_TTL_S',
+                                         '0.5'))
 
 ENV_REPLICA_PORT = 'SKYTPU_SERVE_REPLICA_PORT'
 ENV_REPLICA_ID = 'SKYTPU_SERVE_REPLICA_ID'
@@ -66,6 +75,8 @@ class ReplicaManager:
         # replica_id -> consecutive probe failures
         self._probe_failures: Dict[int, int] = {}
         self._lock = threading.Lock()
+        # (replicas_version, monotonic_at, rows) — see _replica_rows.
+        self._view_cache: Optional[Tuple[int, float, List[dict]]] = None
 
     def set_template(self, spec: ServiceSpec, task: task_lib.Task,
                      version: int) -> None:
@@ -464,6 +475,33 @@ class ReplicaManager:
         return JobStatus(job['status']).is_terminal()
 
     # ----- views --------------------------------------------------------------
+    def _replica_rows(self) -> List[dict]:
+        """Cached live-replica snapshot backing the read-only views
+        (ready_replicas / num_live).
+
+        These views are hammered — the fleetsim decision loop calls
+        them several times per tick, and `replicas.ready_view` was the
+        #1 entry in BENCH_r07's per-run profile because every call
+        re-queried the full replicas table.  The snapshot is keyed on
+        serve_state.replicas_version() (exact invalidation: any
+        replica write in this process bumps it) plus the
+        SKYTPU_READY_VIEW_TTL_S backstop for out-of-process writers.
+        Callers must not mutate the returned rows."""
+        from skypilot_tpu.server import metrics as metrics_lib
+        version = serve_state.replicas_version()
+        cached = self._view_cache
+        if (_READY_VIEW_TTL_S > 0 and cached is not None and
+                cached[0] == version and
+                time.monotonic() - cached[1] <= _READY_VIEW_TTL_S):
+            metrics_lib.inc_counter(
+                'skytpu_serve_ready_view_cache_total', result='hit')
+            return cached[2]
+        metrics_lib.inc_counter(
+            'skytpu_serve_ready_view_cache_total', result='miss')
+        rows = serve_state.get_replicas(self.service_name)
+        self._view_cache = (version, time.monotonic(), rows)
+        return rows
+
     def ready_urls(self) -> List[str]:
         return [url for _, url, _ in self.ready_replicas()]
 
@@ -474,12 +512,12 @@ class ReplicaManager:
         monolithic)."""
         return [
             (r['replica_id'], r['url'], r.get('role'))
-            for r in serve_state.get_replicas(self.service_name)
+            for r in self._replica_rows()
             if r['status'] is ReplicaStatus.READY and r['url']
         ]
 
     def num_live(self, role: Optional[str] = None) -> int:
         return sum(
-            1 for r in serve_state.get_replicas(self.service_name)
+            1 for r in self._replica_rows()
             if r['status'].counts_toward_target() and
             (role is None or r.get('role') == role))

@@ -6,6 +6,7 @@ from __future__ import annotations
 
 import enum
 import json
+import threading
 import time
 from typing import Any, Dict, List, Optional
 
@@ -93,6 +94,26 @@ def _ensure() -> str:
     return path
 
 
+# Process-local replica-table mutation counter.  Every write path in
+# this module bumps it; cached read views (replica_managers' ready
+# view) key on it for exact same-process invalidation.  Writers in
+# OTHER processes (the Postgres control plane shares the tables) are
+# invisible to this counter — cache holders pair it with a short TTL.
+_replicas_version_lock = threading.Lock()
+_replicas_version = 0
+
+
+def _bump_replicas_version() -> None:
+    global _replicas_version
+    with _replicas_version_lock:
+        _replicas_version += 1
+
+
+def replicas_version() -> int:
+    """Monotonic count of replica-table writes made by this process."""
+    return _replicas_version
+
+
 # ----- services ---------------------------------------------------------------
 def add_service(name: str, spec: Dict[str, Any],
                 task_config: Dict[str, Any], lb_port: int) -> bool:
@@ -107,6 +128,7 @@ def add_service(name: str, spec: Dict[str, Any],
             conn.execute('DELETE FROM services WHERE name=?', (name,))
             conn.execute('DELETE FROM replicas WHERE service_name=?',
                          (name,))
+            _bump_replicas_version()
         conn.execute(
             'INSERT INTO services (name, spec, task_config, status, '
             'lb_port, created_at) VALUES (?,?,?,?,?,?)',
@@ -144,6 +166,7 @@ def remove_service(name: str) -> None:
     with db_utils.transaction(path) as conn:
         conn.execute('DELETE FROM services WHERE name=?', (name,))
         conn.execute('DELETE FROM replicas WHERE service_name=?', (name,))
+    _bump_replicas_version()
 
 
 def _service_row(row) -> Dict[str, Any]:
@@ -200,6 +223,7 @@ def add_replica(service_name: str, replica_id: int, cluster_name: str,
         (replica_id, service_name, cluster_name,
          ReplicaStatus.PROVISIONING.value, int(is_spot), zone,
          time.time(), version, role))
+    _bump_replicas_version()
 
 
 def set_replica_status(service_name: str, replica_id: int,
@@ -207,6 +231,7 @@ def set_replica_status(service_name: str, replica_id: int,
     db_utils.execute(
         _ensure(), 'UPDATE replicas SET status=? WHERE service_name=? '
         'AND replica_id=?', (status.value, service_name, replica_id))
+    _bump_replicas_version()
 
 
 def set_replica_status_if(service_name: str, replica_id: int,
@@ -233,6 +258,8 @@ def set_replica_status_if(service_name: str, replica_id: int,
                 'UPDATE replicas SET status=? WHERE service_name=? AND '
                 'replica_id=? AND status=?',
                 (status.value, service_name, replica_id, expected.value))
+        if cur.rowcount > 0:
+            _bump_replicas_version()
         return cur.rowcount > 0
 
 
@@ -242,6 +269,7 @@ def set_replica_endpoint(service_name: str, replica_id: int, url: str,
         _ensure(), 'UPDATE replicas SET url=?, cluster_job_id=? '
         'WHERE service_name=? AND replica_id=?',
         (url, cluster_job_id, service_name, replica_id))
+    _bump_replicas_version()
 
 
 def get_replicas(service_name: str,

@@ -108,6 +108,28 @@ _HELP = {
     'skytpu_engine_active_slots': 'Decode slots occupied this step',
     'skytpu_engine_queue_depth':
         'Requests waiting in the prefill queue',
+    # ----- device-level perf attribution (perf/) ---------------------------
+    'skytpu_engine_mfu':
+        'Live decode model-FLOPs utilization (%): the static '
+        'per-dispatch cost model (perf/cost_model.py) evaluated at the '
+        'loop thread\'s host-side token rate and mean context — zero '
+        'added device syncs (test-enforced)',
+    'skytpu_engine_hbm_bytes_per_token':
+        'Modeled HBM traffic per decoded token (bytes): one weight '
+        'stream amortized over the active batch plus the KV history '
+        'read/write at the current mean context and cache dtype (an '
+        'int8 KV cache shows up as a measured halving)',
+    'skytpu_engine_arith_intensity':
+        'Modeled decode arithmetic intensity (FLOPs/HBM byte) at the '
+        'current occupancy — distance from the chip\'s roofline ridge',
+    'skytpu_engine_xla_compile_total':
+        'XLA backend compiles observed in this process '
+        '(jax.monitoring): increments after engine warmup are '
+        'recompile hazards (see the perf.recompile sentinel)',
+    'skytpu_engine_xla_compile_seconds':
+        'XLA backend compile durations (jax.monitoring event stream)',
+    'skytpu_profile_captures_total':
+        'On-demand jax.profiler captures served via /debug/profile',
     # ----- serve load balancer -------------------------------------------
     'skytpu_lb_requests_total':
         'Proxied requests by replica and upstream status code',
@@ -140,6 +162,12 @@ _HELP = {
         'Training throughput over the recent logging window',
     'skytpu_train_mfu_percent':
         'Estimated model FLOPs utilization (bench.py accounting)',
+    'skytpu_train_hbm_bytes_per_token':
+        'Modeled training HBM traffic per token (weight fwd+bwd '
+        'streams, gradient write, optimizer-state read/write, '
+        'amortized over the step\'s tokens — train/flops.py)',
+    'skytpu_train_arith_intensity':
+        'Modeled training arithmetic intensity (FLOPs/HBM byte)',
     # ----- managed jobs ----------------------------------------------------
     'skytpu_jobs_preemptions_total':
         'Task clusters lost to preemption (cloud says not-UP)',
@@ -151,6 +179,10 @@ _HELP = {
     # ----- serve replicas --------------------------------------------------
     'skytpu_serve_replica_preemptions_total':
         'Serve replicas lost to preemption',
+    'skytpu_serve_ready_view_cache_total':
+        'ready_replicas()/num_live() lookups by result (hit = served '
+        'from the version-keyed cache, miss = full state re-query) — '
+        'the fleetsim ready_view hot path rides this cache',
     # ----- fleet simulator (fleetsim/) -------------------------------------
     'skytpu_fleetsim_control_seconds':
         'Wall time of one control-plane step inside a fleet '
@@ -194,6 +226,11 @@ _BUCKETS: Dict[str, Tuple[float, ...]] = {
     'skytpu_train_step_seconds':
         (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
          60.0, 120.0),
+    # XLA compiles: sub-second tiny-model CPU compiles through
+    # multi-minute 70B-class sharded programs.
+    'skytpu_engine_xla_compile_seconds':
+        (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
+         300.0),
     # Control-plane steps in a fleet sim: same shape as db ops (they
     # are mostly made OF db ops) with a longer tail for chunked
     # thousand-replica scale-ups.

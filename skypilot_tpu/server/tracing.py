@@ -112,6 +112,15 @@ SPAN_HELP = {
         'Decode-role admission scattered a KV handoff\'s pages into '
         'the local pool and seeded the slot from the transferred '
         'first token — occupies the prefill slot of the TTFT tiling',
+    # ----- device-level perf observability (perf/) -------------------------
+    'perf.recompile':
+        'Post-warmup XLA compile caught by the runtime recompile '
+        'sentinel (rid "recompile-sentinel"): attrs carry the traced '
+        'input shapes and compile seconds.  SKYTPU_STRICT_RECOMPILE=1 '
+        'escalates this event to a hard failure in the compiling call',
+    'perf.profile_capture':
+        'On-demand jax.profiler window served by /debug/profile '
+        '(attrs: Perfetto artifact path and size)',
     # ----- managed jobs (postmortem events) --------------------------------
     'jobs.preemption':
         'Managed job cluster lost to preemption (cloud says not-UP)',

@@ -55,3 +55,34 @@ def estimate_mfu(tokens_per_s: float, n_params: int, n_layers: int,
                        train_flops_per_token(n_params, n_layers, dim,
                                              seq_len) / 1e12)
     return 100.0 * achieved_tflops / (peak * max(1, n_chips))
+
+
+def train_hbm_bytes_per_token(n_params: int, tokens_per_step: int,
+                              param_bytes: int = 2,
+                              opt_state_bytes: int = 8) -> float:
+    """Modeled HBM traffic per trained token: the trainer twin of the
+    decode cost model's bytes/token gauge (perf/cost_model.py).
+
+    One optimizer step streams the weight tree through HBM a fixed
+    number of times — forward read + backward read (2x params), the
+    gradient write (1x), and the Adam moment read-modify-write (2x the
+    f32 m/v pair) — all amortized over the step's token count.
+    Activation traffic is recompute-dominated under remat and omitted;
+    this is a floor, matching the decode model's roofline role."""
+    if tokens_per_step <= 0:
+        return 0.0
+    step_bytes = n_params * (3 * param_bytes + 2 * opt_state_bytes)
+    return step_bytes / tokens_per_step
+
+
+def train_arith_intensity(n_params: int, n_layers: int, dim: int,
+                          seq_len: int, tokens_per_step: int,
+                          param_bytes: int = 2,
+                          opt_state_bytes: int = 8) -> float:
+    """FLOPs per modeled HBM byte for one train step."""
+    bytes_per_token = train_hbm_bytes_per_token(
+        n_params, tokens_per_step, param_bytes, opt_state_bytes)
+    if bytes_per_token <= 0:
+        return 0.0
+    return train_flops_per_token(n_params, n_layers, dim,
+                                 seq_len) / bytes_per_token

@@ -235,13 +235,30 @@ class Trainer:
         if batch is None or cfg is None:
             return
         try:
+            n_params = cfg.num_params()
             mfu = flops_lib.estimate_mfu(
-                tokens_per_s, cfg.num_params(), cfg.n_layers, cfg.dim,
+                tokens_per_s, n_params, cfg.n_layers, cfg.dim,
                 seq_len=batch.shape[-1], n_chips=self.mesh.size)
         except (AttributeError, TypeError):
             return      # cfg not LlamaConfig-shaped: no MFU gauge
         if mfu > 0:
             metrics_lib.set_gauge('skytpu_train_mfu_percent', mfu)
+        # Device-cost twins of the decode engine's perf gauges
+        # (perf/cost_model.py): modeled HBM bytes per trained token and
+        # the resulting arithmetic intensity, from the same shared FLOP
+        # accounting.
+        tokens_per_step = int(batch.size)
+        hbm_bytes = flops_lib.train_hbm_bytes_per_token(
+            n_params, tokens_per_step)
+        if hbm_bytes > 0:
+            metrics_lib.set_gauge('skytpu_train_hbm_bytes_per_token',
+                                  hbm_bytes)
+            metrics_lib.set_gauge(
+                'skytpu_train_arith_intensity',
+                flops_lib.train_arith_intensity(
+                    n_params, cfg.n_layers, cfg.dim,
+                    seq_len=batch.shape[-1],
+                    tokens_per_step=tokens_per_step))
 
     def save_checkpoint(self) -> None:
         if self._ckpt_mgr is not None:

@@ -1,0 +1,14 @@
+"""Known-bad: device-cost perf observability violating the gauge-only
+attribution-suffix convention and the central registries
+(metric-naming rule, perf extension)."""
+from skypilot_tpu.server import metrics as metrics_lib
+from skypilot_tpu.server import tracing
+
+
+def report(rid, hbm_bytes, t0):
+    metrics_lib.inc_counter('skytpu_engine_mfu')    # BAD: registered name, wrong kind (gauge-only suffix + missing _total)
+    metrics_lib.observe_hist(
+        'skytpu_engine_rogue_bytes_per_token',
+        hbm_bytes)                                  # BAD: gauge-only suffix + no unit suffix + no _HELP
+    tracing.record_instant(rid, 'perf.rogue_capture',
+                           t0)                      # BAD: no SPAN_HELP

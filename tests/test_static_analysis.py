@@ -150,6 +150,18 @@ def test_fixture_findings_land_where_expected():
     assert 'skytpu_engine_kv_rogue_pages' in page_msgs
     assert 'skytpu_engine_prefix_cache_rogue_total' in page_msgs
     assert 'engine.prefix_rogue' in page_msgs
+    # Perf fixture: device-cost attribution suffixes (_mfu /
+    # _per_token / _intensity) are gauge-only — flagged even when the
+    # family IS registered (skytpu_engine_mfu has a _HELP entry) —
+    # and perf.* spans are held to the span registry like any other.
+    perf_hits = [f for f in by_rule['metric-naming']
+                 if f.path == 'bad_perf.py']
+    assert len(perf_hits) == 6
+    perf_msgs = ' '.join(f.message for f in perf_hits)
+    assert sum('legal only as gauges' in f.message
+               for f in perf_hits) == 2
+    assert 'skytpu_engine_rogue_bytes_per_token' in perf_msgs
+    assert 'perf.rogue_capture' in perf_msgs
     # State-backend fixture: db_op families are held to the same bar
     # (unit suffix on the histogram, _HELP entry on both).
     db_hits = [f for f in by_rule['metric-naming']
