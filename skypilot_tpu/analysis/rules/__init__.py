@@ -15,6 +15,7 @@ from skypilot_tpu.analysis.rules.hot_loop_sync import HotLoopSyncRule
 from skypilot_tpu.analysis.rules.metric_naming import MetricNamingRule
 from skypilot_tpu.analysis.rules.recompile_hazard import (
     RecompileHazardRule)
+from skypilot_tpu.analysis.rules.speculation import SpeculationRule
 from skypilot_tpu.analysis.rules.unbounded_io import UnboundedIoRule
 
 
@@ -26,4 +27,5 @@ def all_rules() -> List[Rule]:
         DbDisciplineRule(),
         UnboundedIoRule(),
         MetricNamingRule(),
+        SpeculationRule(),
     ]

@@ -1,0 +1,93 @@
+"""speculation: the verify dispatch stays a fixed, pinned program.
+
+Speculative decoding lives or dies on its dispatch discipline: the
+propose/verify loop runs every engine step, so the verify program must
+be built ONCE (per bucket/draft-length shape) and pinned like every
+other hot-path program.  Two hazards, both of which silently turn the
+speculation win into a per-step compile stall:
+
+1. ``jax.jit(...)`` called INSIDE a propose/verify/draft function —
+   a fresh wrapper per step defeats the compile cache (each wrapper
+   has its own identity), exactly the recompile-hazard loop failure
+   mode but reached through the speculation path (these functions are
+   called from the engine loop even when they are not lexically inside
+   a loop, so the loop-based rule cannot see it).
+
+2. A verify program jitted WITHOUT pinned shardings or donated state
+   (``in_shardings``/``out_shardings``/``donate_argnums``/
+   ``donate_argnames``): the verify call carries the page pool —
+   engine state that must be donated (call k+1 reuses call k's
+   buffer) and whose placement must be committed, or input drift
+   recompiles mid-traffic and the pool double-buffers in HBM.
+
+The engine's real wiring (``self._verify = jax.jit(self._verify_raw,
+donate_argnums=...)`` built once in ``_build_paged_jits``) is clean
+under both checks.  Suppress with ``# skytpu: allow-spec(<why>)``.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import List
+
+from skypilot_tpu.analysis import callgraph as cg
+from skypilot_tpu.analysis.core import (Finding, Project, Rule,
+                                        iter_non_def_descendants)
+
+# Function names that constitute the speculation hot loop.
+_SPEC_FN_RE = re.compile(r'(propose|verify|draft)', re.IGNORECASE)
+_PIN_KWARGS = ('in_shardings', 'out_shardings', 'donate_argnums',
+               'donate_argnames')
+
+
+class SpeculationRule(Rule):
+    name = 'speculation'
+    suppress_token = 'spec'
+    description = ('the speculative verify dispatch must stay jit-'
+                   'pinned: no jax.jit inside propose/verify/draft '
+                   'functions (fresh wrapper per step = per-step '
+                   'compile), and a jitted verify program must pin '
+                   'shardings or donate state')
+
+    def check(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for module in project.modules:
+            for node in ast.walk(module.tree):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) and \
+                        _SPEC_FN_RE.search(node.name):
+                    for call in iter_non_def_descendants(node):
+                        if isinstance(call, ast.Call) and \
+                                cg.is_jit_call(call, module):
+                            findings.append(project.finding(
+                                self, module, call,
+                                f'jax.jit inside {node.name!r}: the '
+                                f'propose/verify loop runs every '
+                                f'engine step — a fresh jit wrapper '
+                                f'per call defeats the compile cache; '
+                                f'build the verify program once and '
+                                f'dispatch it'))
+                if isinstance(node, ast.Call) and \
+                        cg.is_jit_call(node, module) and \
+                        self._jits_verify_program(node) and \
+                        not any(kw.arg in _PIN_KWARGS
+                                for kw in node.keywords):
+                    findings.append(project.finding(
+                        self, module, node,
+                        'verify program jitted without pinned '
+                        'in/out shardings or donated state — the '
+                        'verify call carries the page pool: input '
+                        'placement drift recompiles mid-traffic and '
+                        'an undonated pool double-buffers in HBM'))
+        return findings
+
+    @staticmethod
+    def _jits_verify_program(call: ast.Call) -> bool:
+        """True when the jitted callee's (dotted) name names a verify
+        program (``jax.jit(verify_step)``, ``jax.jit(self._verify_raw,
+        ...)``)."""
+        if not call.args:
+            return False
+        dotted = cg._dotted(call.args[0])
+        return dotted is not None and \
+            'verify' in dotted.split('.')[-1].lower()

@@ -117,6 +117,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from skypilot_tpu import sky_logging
+from skypilot_tpu.inference import kv_quant
 from skypilot_tpu.inference.paging import TRASH_PAGE, PagePool, RadixCache
 from skypilot_tpu.perf import compile_telemetry
 from skypilot_tpu.perf import cost_model as cost_model_lib
@@ -169,6 +170,21 @@ class EngineConfig:
     # prefill and references the cached pages (LRU-evicted when the
     # pool runs short).  Ignored without paging.
     prefix_cache: bool = True
+    # KV cache element type for the paged pool: 'bf16' keeps the model
+    # dtype; 'int8' quantizes pages at scatter time (symmetric absmax
+    # along head_dim, one f32 scale per position — kv_quant.QuantPages)
+    # and dequantizes inside the attention gather, halving decode's
+    # dominant HBM stream.  Requires kv_page_size.
+    kv_dtype: str = 'bf16'
+    # Self-speculative decoding: draft length k per slot.  0 = off.
+    # A host-side n-gram proposer drafts k tokens per slot from its own
+    # history; ONE fixed-shape verify dispatch (the chunked S = k+1
+    # position-scatter path) scores all drafts and accepts the longest
+    # greedy-matching prefix — lossless under greedy sampling, so
+    # outputs are token-identical to speculation-off.  Requires
+    # kv_page_size (rejected rows land in slot-owned/trash pages) and
+    # temperature == 0.0.
+    speculation: int = 0
 
 
 @dataclasses.dataclass
@@ -271,6 +287,44 @@ class _ChunkedPrefill:
         self.shared_pages = shared_pages or []
 
 
+def _ngram_continuation(hist: List[int], k: int, max_ngram: int = 3,
+                        window: int = 512) -> np.ndarray:
+    """Self-speculative n-gram draft: the k tokens that followed the
+    most recent earlier occurrence of ``hist``'s tail n-gram (longest
+    n first, n = max_ngram..1), zero-padded when the match runs out.
+
+    Pure host arithmetic over the slot's own token history — no second
+    model, no device work.  On repetitive traffic (code, templated
+    text, multi-turn replays) the continuation after a repeated n-gram
+    is usually the same continuation, which is exactly what verify
+    accepts; on incompressible traffic drafts self-reject to m=1 and
+    the engine degrades to plain (correct) decode.  Only the last
+    ``window`` tokens are scanned: a bounded O(window * max_ngram)
+    per slot per step, never proportional to the full context.
+    """
+    out = np.zeros((k,), np.int32)
+    ln = len(hist)
+    if ln < 2:
+        return out
+    lo = max(0, ln - window)
+    for n in range(min(max_ngram, ln - 1), 0, -1):
+        tail = hist[ln - n:]
+        # Most recent earlier occurrence: scan ends before the tail
+        # itself (i + n < ln) so the draft continues PAST the match.
+        for i in range(ln - n - 1, lo - 1, -1):
+            if hist[i:i + n] == tail:
+                # When the match overlaps the tail (a cycling stream —
+                # the case speculation wins hardest on), the observed
+                # continuation is shorter than k; extend it cyclically
+                # instead of zero-padding, so a period-p loop drafts
+                # the whole next k tokens, not just p of them.
+                span = ln - (i + n)
+                for j in range(k):
+                    out[j] = hist[i + n + (j if j < span else j % span)]
+                return out
+    return out
+
+
 class DecodeEngine:
     """Slot-based continuous batching over a Llama-family model.
 
@@ -316,6 +370,8 @@ class DecodeEngine:
         # bookkeeping is loop-thread state; only the table itself is
         # shipped to device (async H2D, refreshed when dirty).
         self._paged = config.kv_page_size is not None
+        self._kv_quant = self._paged and config.kv_dtype == 'int8'
+        self._spec_k = config.speculation if self._paged else 0
         self._page_size = config.kv_page_size
         self._pages_per_slot = (max_len // config.kv_page_size
                                 if self._paged else 0)
@@ -417,7 +473,8 @@ class DecodeEngine:
         self._cost_model = cost_model_lib.EngineCostModel.from_engine_state(
             self.model.cfg, jax.tree_util.tree_leaves(self.params),
             jax.tree_util.tree_leaves(self._cache),
-            n_chips=self._mesh.size if self._mesh is not None else 1)
+            n_chips=self._mesh.size if self._mesh is not None else 1,
+            kv_dtype=config.kv_dtype if self._paged else None)
 
     @property
     def healthy(self) -> bool:
@@ -461,8 +518,33 @@ class DecodeEngine:
         offending values: kv_page_size must divide every prefill bucket
         and max_seq_len (page-aligned inserts and prefix matches depend
         on it), and the pool must fit at least one max-length request
-        plus the trash page."""
+        plus the trash page.  kv_dtype and speculation are validated
+        here too: both are properties of the paged substrate."""
         ps = config.kv_page_size
+        if config.kv_dtype not in ('bf16', 'int8'):
+            raise ValueError(
+                f"kv_dtype must be 'bf16' or 'int8', got "
+                f"{config.kv_dtype!r}")
+        if config.kv_dtype == 'int8' and ps is None:
+            raise ValueError(
+                'kv_dtype=int8 quantizes the PAGED pool at scatter '
+                'time; set kv_page_size (the contiguous cache keeps '
+                'the model dtype)')
+        if config.speculation < 0:
+            raise ValueError(
+                f'speculation must be a non-negative draft length, '
+                f'got {config.speculation}')
+        if config.speculation > 0:
+            if ps is None:
+                raise ValueError(
+                    'speculation requires kv_page_size: rejected draft '
+                    'rows must land in slot-owned/trash pages, not the '
+                    'contiguous cache')
+            if config.temperature != 0.0:
+                raise ValueError(
+                    f'speculation is greedy-only (accept = exact argmax '
+                    f'match, lossless at temperature 0.0); got '
+                    f'temperature={config.temperature}')
         if ps is None:
             return
         if ps <= 0:
@@ -534,10 +616,11 @@ class DecodeEngine:
         if self._paged:
             # The page pool [n_pages, n_kv_heads, page_size, head_dim]
             # shards over the same kv-heads dim as the dense cache, so
-            # page gathers/scatters (dim 0) stay local per chip.
-            cache_abs = jax.tree.map(
-                lambda l: jax.ShapeDtypeStruct(self._pool_shape(l.shape),
-                                               l.dtype), cache_abs)
+            # page gathers/scatters (dim 0) stay local per chip.  An
+            # int8 pool's scale leaf [n_pages, H, page_size] shards
+            # over the same H dim (axis 1 — _kv_or_repl is rank-
+            # agnostic).
+            cache_abs = jax.tree.map(self._pool_abs, cache_abs)
         self._cache_shardings = jax.tree.map(_kv_or_repl, cache_abs)
         # The chunked-prefill scratch cache [1, n_kv_heads, max_len, D]
         # shards over kv heads exactly like the big cache.
@@ -550,6 +633,17 @@ class DecodeEngine:
         [n_pages, H, page_size, D]."""
         return (self._pool_alloc.n_pages, dense_shape[1],
                 self._page_size, dense_shape[3])
+
+    def _pool_abs(self, dense_leaf):
+        """Abstract pool node for one dense cache leaf: a plain
+        ShapeDtypeStruct, or a QuantPages of (int8 data, f32 scales)
+        under kv_dtype=int8."""
+        shape = self._pool_shape(dense_leaf.shape)
+        if self._kv_quant:
+            return kv_quant.QuantPages(
+                jax.ShapeDtypeStruct(shape, jnp.int8),
+                jax.ShapeDtypeStruct(shape[:3], jnp.float32))
+        return jax.ShapeDtypeStruct(shape, dense_leaf.dtype)
 
     def _make_cache(self, params, n: Optional[int] = None):
         """Trace a dummy decode batch; returns the per-layer cache for
@@ -681,6 +775,11 @@ class DecodeEngine:
         # async; nothing below adds a sync.
         ps_ = self.cfg.kv_page_size
         n_pp = self._pages_per_slot
+        # kv_dtype=int8: pool leaves are kv_quant.QuantPages pairs.
+        # tree_maps that pair the pool against a DENSE cache treat the
+        # QuantPages node as one leaf (is_leaf below); maps that pair
+        # pool against pool (adopt) descend into raw arrays unchanged.
+        _is_qp = lambda x: isinstance(x, kv_quant.QuantPages)  # noqa: E731
 
         def _to_pages(small):
             """Dense rows [N, H, L, D] -> page stacks [N, P, ps, H, D]
@@ -709,9 +808,16 @@ class DecodeEngine:
             firsts = jnp.where(valid.astype(bool), firsts, firsts[0])
 
             def _ins(pool_leaf, small):
-                return pool_leaf.at[pt_rows].set(_to_pages(small))
+                pages = _to_pages(small)
+                if _is_qp(pool_leaf):
+                    qd, s = kv_quant.quantize_kv(pages)
+                    return kv_quant.QuantPages(
+                        pool_leaf.data.at[pt_rows].set(qd),
+                        pool_leaf.scale.at[pt_rows].set(s))
+                return pool_leaf.at[pt_rows].set(pages)
 
-            pool = jax.tree_util.tree_map(_ins, pool, cache['cache'])
+            pool = jax.tree_util.tree_map(_ins, pool, cache['cache'],
+                                          is_leaf=_is_qp)
             return (pool, last_toks.at[slots].set(firsts),
                     lens.at[slots].set(lengths))
 
@@ -735,6 +841,49 @@ class DecodeEngine:
             out = jnp.concatenate([last_tokens[None, :], toks], axis=0)
             return out, pool, last, lens
 
+        def verify_paged(params, pool, pt, last_tokens, lengths, drafts):
+            """Speculative VERIFY: score every slot's k host-drafted
+            tokens in ONE fixed-shape dispatch and accept the longest
+            greedy-matching prefix.  [last, d_1..d_k] runs through the
+            model's S = k+1 position-scatter path (the chunked-prefill
+            machinery), so g[:, j] is the greedy continuation after
+            consuming the draft prefix up to j; draft d_j is accepted
+            iff d_j == g[:, j-1] and acceptance stops at the first
+            mismatch.  m in [1, k+1] tokens commit per slot per call
+            (m = 1 == plain decode: g[:, 0] IS the token decode would
+            have sampled — greedy speculation is lossless).  Rejected
+            rows leave K/V garbage strictly at positions >= the new
+            length; the next call's writes land at exactly those
+            positions before its gather, so the causal-mask invariant
+            holds.  Empty slots draft zeros against trash-page tables;
+            their m is garbage the host never reads.
+
+            Output rows: [0] = incoming last tokens (same contract as
+            decode), [1..k+1] = greedy continuations, [k+2] = m — the
+            acceptance counts ride the SAME single fetch as the
+            tokens, keeping the one-sync-per-step contract."""
+            kk = drafts.shape[1]
+            toks = jnp.concatenate([last_tokens[:, None], drafts],
+                                   axis=1)                    # [B, k+1]
+            positions = jnp.minimum(
+                lengths[:, None] + jnp.arange(kk + 1)[None, :],
+                max_len - 1)
+            logits, new_cache = model.apply(
+                {'params': params, 'cache': pool}, toks,
+                positions=positions, decode=True, page_table=pt,
+                mutable=['cache'])
+            g = jnp.argmax(logits, axis=-1).astype(
+                last_tokens.dtype)                            # [B, k+1]
+            match = jnp.cumprod(
+                (drafts == g[:, :kk]).astype(jnp.int32), axis=1)
+            m = 1 + jnp.sum(match, axis=1)                    # [B]
+            last = jnp.take_along_axis(g, (m - 1)[:, None],
+                                       axis=1)[:, 0]
+            out = jnp.concatenate(
+                [last_tokens[None, :], g.T,
+                 m[None, :].astype(last_tokens.dtype)], axis=0)
+            return out, new_cache['cache'], last, lengths + m
+
         def gather_prefix(pool, pt_row):
             """Prefix-cache hit: materialize the matched pages into a
             DENSE scratch cache [1, H, max_len, D] so the remaining
@@ -743,12 +892,16 @@ class DecodeEngine:
             Unmatched entries are trash pages — garbage strictly above
             every query position the suffix will use."""
             def _g(leaf):
-                g = leaf[pt_row]                  # [P, H, ps, D]
+                if _is_qp(leaf):
+                    g = kv_quant.dequantize_kv(
+                        leaf.data[pt_row], leaf.scale[pt_row],
+                        model.cfg.dtype)          # [P, H, ps, D]
+                else:
+                    g = leaf[pt_row]              # [P, H, ps, D]
                 g = g.transpose(1, 0, 2, 3)       # [H, P, ps, D]
-                return g.reshape(1, leaf.shape[1], n_pp * ps_,
-                                 leaf.shape[3])
+                return g.reshape(1, g.shape[0], n_pp * ps_, g.shape[3])
 
-            return jax.tree_util.tree_map(_g, pool)
+            return jax.tree_util.tree_map(_g, pool, is_leaf=_is_qp)
 
         def chunk_insert_paged(params, pool, last_toks, lens, scratch,
                                tokens, length, offset, total_len, slot,
@@ -769,9 +922,16 @@ class DecodeEngine:
             first = sample(last, rng)
 
             def _ins(pool_leaf, small):
-                return pool_leaf.at[pt_row].set(_to_pages(small)[0])
+                pages = _to_pages(small)[0]
+                if _is_qp(pool_leaf):
+                    qd, s = kv_quant.quantize_kv(pages)
+                    return kv_quant.QuantPages(
+                        pool_leaf.data.at[pt_row].set(qd),
+                        pool_leaf.scale.at[pt_row].set(s))
+                return pool_leaf.at[pt_row].set(pages)
 
-            pool = jax.tree_util.tree_map(_ins, pool, cache['cache'])
+            pool = jax.tree_util.tree_map(_ins, pool, cache['cache'],
+                                          is_leaf=_is_qp)
             return (pool, last_toks.at[slot].set(first[0]),
                     lens.at[slot].set(total_len))
 
@@ -809,6 +969,7 @@ class DecodeEngine:
             self._gather_raw = gather_prefix
             self._export_raw = export_pages
             self._adopt_raw = adopt_insert
+            self._verify_raw = verify_paged
         self._prefill_raw = prefill_insert
         self._decode_raw = decode
         self._chunk_raw = prefill_chunk
@@ -864,6 +1025,9 @@ class DecodeEngine:
                                            donate_argnums=(1, 2, 3))
             self._decode = jax.jit(self._decode_raw,
                                    donate_argnums=(1, 3, 4))
+            if self._spec_k:
+                self._verify = jax.jit(self._verify_raw,
+                                       donate_argnums=(1, 3, 4))
             self._prefill_chunk = jax.jit(self._chunk_raw,
                                           donate_argnums=(1,))
             self._chunk_insert = jax.jit(self._chunk_insert_raw,
@@ -886,6 +1050,11 @@ class DecodeEngine:
             self._decode_raw, donate_argnums=(1, 3, 4),
             in_shardings=(p_sh, c_sh, r, r, r, r),
             out_shardings=(r, c_sh, r, r))
+        if self._spec_k:
+            self._verify = jax.jit(
+                self._verify_raw, donate_argnums=(1, 3, 4),
+                in_shardings=(p_sh, c_sh, r, r, r, r),
+                out_shardings=(r, c_sh, r, r))
         self._prefill_chunk = jax.jit(
             self._chunk_raw, donate_argnums=(1,),
             in_shardings=(p_sh, s_sh, r, r), out_shardings=s_sh)
@@ -937,8 +1106,12 @@ class DecodeEngine:
         cache_abs = jax.eval_shape(self._make_cache, self.params)
 
         def make_pool(_params):
+            # _pool_abs: a ShapeDtypeStruct, or a QuantPages pair of
+            # them under int8 — zero both through the pytree.
             return jax.tree.map(
-                lambda l: jnp.zeros(self._pool_shape(l.shape), l.dtype),
+                lambda l: jax.tree.map(
+                    lambda a: jnp.zeros(a.shape, a.dtype),
+                    self._pool_abs(l)),
                 cache_abs)
 
         if self._mesh is None:
@@ -1521,6 +1694,17 @@ class DecodeEngine:
             _, self._cache, self._last_d, self._lens_d = self._decode(
                 self.params, self._cache, self._pt(), self._last_d,
                 self._lens_d, self._next_rng())
+            if self._spec_k:
+                # The verify program is the only other steady-state
+                # shape: zero drafts against all-trash tables (every
+                # write lands in the trash page; slot state is donated
+                # back scribbled like the decode warm above).
+                _, self._cache, self._last_d, self._lens_d = \
+                    self._verify(
+                        self.params, self._cache, self._pt(),
+                        self._last_d, self._lens_d,
+                        jnp.zeros((self.cfg.n_slots, self._spec_k),
+                                  jnp.int32))
         else:
             _, self._cache, self._last_d, self._lens_d = self._decode(
                 self.params, self._cache, self._last_d, self._lens_d,
@@ -1630,12 +1814,35 @@ class DecodeEngine:
         return row
 
     def _dispatch_decode(self):
+        if self._spec_k:
+            # Speculative step: k host-drafted tokens per slot, one
+            # fixed-shape verify dispatch (same 4-tuple contract as
+            # decode; the acceptance counts ride the output's last
+            # row).  Greedy, so no rng.
+            return self._verify(self.params, self._cache, self._pt(),
+                                self._last_d, self._lens_d,
+                                jnp.asarray(self._propose_drafts()))
         if self._paged:
             return self._decode(self.params, self._cache, self._pt(),
                                 self._last_d, self._lens_d,
                                 self._next_rng())
         return self._decode(self.params, self._cache, self._last_d,
                             self._lens_d, self._next_rng())
+
+    def _propose_drafts(self) -> np.ndarray:
+        """Host-side n-gram drafts [n_slots, k] for the next verify
+        dispatch: each active slot's draft is the continuation of the
+        most recent earlier occurrence of its own tail n-gram (self-
+        speculation — no second model).  Empty/retired slots draft
+        zeros against all-trash page tables; their acceptance counts
+        are garbage the host never reads."""
+        drafts = np.zeros((self.cfg.n_slots, self._spec_k), np.int32)
+        for i, slot in enumerate(self._slots):
+            if slot is None or slot.request is None:
+                continue
+            hist = slot.request.prompt_ids + slot.toks
+            drafts[i] = _ngram_continuation(hist, self._spec_k)
+        return drafts
 
     def _admit_group(self, bucket: int, group) -> None:
         """Dispatch ONE batched prefill+insert for all (slot, request,
@@ -1717,6 +1924,11 @@ class DecodeEngine:
             self._queued_tokens -= n_tokens
         metrics_lib.inc_counter('skytpu_engine_prefill_tokens_total',
                                 float(n_tokens))
+        if self._kv_quant:
+            # Real (non-trash) pages quantized at this insert's scatter.
+            metrics_lib.inc_counter(
+                'skytpu_engine_kv_quant_pages_total',
+                float(sum(len(pg) for _, _r, pg in group)))
 
     def _emit(self, req: Request, tok: int) -> None:
         req.emitted += 1
@@ -1877,6 +2089,9 @@ class DecodeEngine:
                         req.prompt_ids[:n_full * self._page_size],
                         pages[:n_full])
             metrics_lib.inc_counter('skytpu_engine_kv_adopts_total')
+            if self._kv_quant:
+                metrics_lib.inc_counter(
+                    'skytpu_engine_kv_quant_pages_total', float(n_kv))
             if req.request_id is not None:
                 tracing.record_span(req.request_id, 'engine.queue_wait',
                                     req.submitted_at, t0)
@@ -2134,6 +2349,10 @@ class DecodeEngine:
                             pages_all[:n_full])
             self._chunked = None
             done = rem
+            if self._kv_quant and pages_all is not None:
+                metrics_lib.inc_counter(
+                    'skytpu_engine_kv_quant_pages_total',
+                    float(len(pages_all)))
         with self._submit_lock:
             self._queued_tokens -= done
         metrics_lib.inc_counter('skytpu_engine_prefill_chunks_total')
@@ -2231,11 +2450,21 @@ class DecodeEngine:
         if not active:
             self._release_retiring()
             return 0
+        t0 = time.perf_counter()
         out, self._cache, self._last_d, self._lens_d = \
             self._dispatch_decode()
         # skytpu: allow-sync(the ONE device->host fetch per step — the engine's contract)
         out = np.asarray(out)            # [T+1, B] — the ONE sync per step
-        self._process_rows(out, {i: self._slots[i] for i in active})
+        t1 = time.perf_counter()
+        snapshot = {i: self._slots[i] for i in active}
+        if self._spec_k:
+            # Speculative verify: the last output row is the per-slot
+            # acceptance count m (1..k+1) — rows 1..m are committed
+            # tokens, rows past m are rejected drafts' garbage.
+            self._process_rows(out[:-1], snapshot, counts=out[-1],
+                               verify_span=(t0, t1))
+        else:
+            self._process_rows(out, snapshot)
         self._release_retiring()
         return len(active)
 
@@ -2266,6 +2495,14 @@ class DecodeEngine:
         Returns #slots active in the dispatched call plus any chunk
         dispatched (0 = fully idle and nothing in flight).
         """
+        if self._spec_k:
+            # Speculation replaces pipelining: dispatching call k's
+            # drafts before call k-1's tokens land would draft from
+            # one-call-stale history and collapse acceptance.  The
+            # multi-token verify dispatch is the latency-hiding lever
+            # instead; step() keeps the same admission/chunked/adopt
+            # machinery and the one-sync contract.
+            return self.step()
         self._install_staged()
         active = [i for i in range(self.cfg.n_slots)
                   if self._slots[i] is not None]
@@ -2303,18 +2540,38 @@ class DecodeEngine:
         self._admit_free(handoff)
         return len(active) + (1 if chunked else 0)
 
-    def _process_rows(self, out: np.ndarray, snapshot: Dict[int, _Slot]
-                      ) -> None:
+    def _process_rows(self, out: np.ndarray, snapshot: Dict[int, _Slot],
+                      counts: Optional[np.ndarray] = None,
+                      verify_span: Optional[tuple] = None) -> None:
         """Emit one decode call's tokens to the slots captured at its
         DISPATCH time.  A slot whose occupant changed since (retired, or
         retired-and-readmitted under pipelining) is skipped by object
         identity — its rows are the bounded garbage of the one-call
-        retire lag, never another request's tokens."""
+        retire lag, never another request's tokens.
+
+        ``counts`` (speculative verify calls): the per-slot acceptance
+        count m — only rows 1..m of ``out`` are committed tokens for
+        slot i; the rest are rejected drafts.  ``verify_span`` is the
+        (dispatch, fetch) perf_counter bracket for the engine.verify
+        flight-recorder span of traced requests."""
         now = time.perf_counter()
         emitted = 0
+        spec_proposed = spec_accepted = 0
         for i, slot in snapshot.items():
             if slot.done:
                 continue                 # retired earlier: rows are garbage
+            limit = out.shape[0]
+            if counts is not None:
+                m = int(counts[i])
+                limit = min(m + 1, out.shape[0])
+                spec_proposed += self._spec_k
+                spec_accepted += m - 1
+                rid = slot.request.request_id
+                if rid is not None and verify_span is not None:
+                    tracing.record_span(
+                        rid, 'engine.verify', verify_span[0],
+                        verify_span[1], slot=i,
+                        proposed=self._spec_k, accepted=m - 1)
             start = 0
             if slot.first_pending:
                 slot.first_pending = False
@@ -2342,7 +2599,7 @@ class DecodeEngine:
                                      6))
             else:
                 start = 1                # row 0 was emitted last step
-            for t in range(start, out.shape[0]):
+            for t in range(start, limit):
                 tok = int(out[t, i])
                 slot.length += 1
                 # Device-cost attribution: this token's context length
@@ -2365,6 +2622,15 @@ class DecodeEngine:
         if emitted:
             metrics_lib.inc_counter('skytpu_engine_decode_tokens_total',
                                     float(emitted))
+        if spec_proposed:
+            metrics_lib.inc_counter(
+                'skytpu_engine_spec_proposed_tokens_total',
+                float(spec_proposed))
+            metrics_lib.inc_counter(
+                'skytpu_engine_spec_accepted_tokens_total',
+                float(spec_accepted))
+            metrics_lib.set_gauge('skytpu_engine_spec_acceptance',
+                                  spec_accepted / spec_proposed)
 
 
     def _loop(self):  # skytpu: hot-entry

@@ -1,0 +1,80 @@
+"""Int8 KV-page quantization: the device-side counterpart of paging.py.
+
+paging.py owns page *bookkeeping* and never touches a device array;
+this module owns the page *payload* when ``EngineConfig.kv_dtype ==
+'int8'``.  A quantized pool stores each (layer, K|V) cache as a
+:class:`QuantPages` pair instead of a single dense array:
+
+- ``data``:  int8  ``[n_pages, n_kv_heads, page_size, head_dim]``
+- ``scale``: f32   ``[n_pages, n_kv_heads, page_size]``
+
+i.e. symmetric absmax quantization along ``head_dim``, one scale per
+(page, kv-head, position).  That granularity keeps dequantization a
+single fused multiply inside the attention gather while halving the
+dominant HBM stream on decode (the int8 payload; the f32 scales add
+``4 / head_dim`` bytes per element — ~3% at head_dim 128, accounted
+for explicitly by ``perf/cost_model.py``).
+
+``QuantPages`` is a registered pytree node, so every structural path
+in the engine — pool init, donation, per-leaf KV export/adopt wire
+format, sharding-spec mapping, prewarm zeroing — descends into the
+(data, scale) pair without modification.  Only the scatter/gather
+sites (quantize on insert, dequantize on read) branch on the type.
+
+Quantization is *idempotent under round-trip*: dequantizing a page
+and re-quantizing it reproduces bit-identical (data, scale), because
+absmax of ``q * s`` is ``127 * s`` by construction.  The radix-cache
+shared-prefix invariant (re-inserting a cached prefix writes back
+value-identical pages) therefore survives quantization exactly.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+# Guard against zero scales on all-zero rows (e.g. freshly zeroed
+# pool pages round-tripped through dequant/requant).
+_EPS = 1e-8
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class QuantPages:
+    """An int8 page pool leaf: quantized payload + per-position scales.
+
+    ``data``  int8 ``[..., page_size, head_dim]``
+    ``scale`` f32  ``[..., page_size]`` (one absmax scale per row of
+    ``head_dim`` elements).
+    """
+    data: Any
+    scale: Any
+
+    def tree_flatten(self):
+        return (self.data, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        del aux
+        return cls(*children)
+
+
+def quantize_kv(x):
+    """Symmetric absmax int8 quantization along the last axis.
+
+    Returns ``(q, s)`` with ``q`` int8 of ``x.shape`` and ``s`` f32 of
+    ``x.shape[:-1]`` such that ``q * s[..., None] ~= x``.
+    """
+    x32 = x.astype(jnp.float32)
+    s = jnp.max(jnp.abs(x32), axis=-1) / 127.0
+    q = jnp.clip(jnp.round(x32 / jnp.maximum(s, _EPS)[..., None]),
+                 -127.0, 127.0).astype(jnp.int8)
+    return q, s
+
+
+def dequantize_kv(q, s, dtype):
+    """Inverse of :func:`quantize_kv` (up to rounding), cast to
+    ``dtype`` for the attention matmul."""
+    return (q.astype(jnp.float32) * s[..., None]).astype(dtype)

@@ -21,6 +21,15 @@ Ownership model (the invariant tests/test_serve_paged.py soaks):
   land at positions past the match);
 - freed-page count is conserved: free + referenced == n_pages - 1
   (page 0 is the trash page inactive slots scribble into).
+
+Dtype-blindness: with ``kv_dtype='int8'`` the device pool becomes a
+QuantPages pair — int8 payload of the same ``[n_pages, n_kv_heads,
+page_size, head_dim]`` geometry plus a per-(page, head, position) f32
+scale (inference/kv_quant.py) — but page IDENTITY is unchanged, so
+nothing in this module knows or cares: the allocator, radix cache, and
+refcount invariants operate on page indices, and the same page table
+drives the quantized gather/scatter.  Keep it that way — a dtype
+branch here would couple host bookkeeping to device layout.
 """
 from __future__ import annotations
 

@@ -377,6 +377,23 @@ def main() -> None:
         'them.  Serve specs set it via service.prefix_cache '
         '(SKYTPU_SERVE_PREFIX_CACHE).')
     parser.add_argument(
+        '--kv-dtype', choices=('bf16', 'int8'),
+        default=os.environ.get('SKYTPU_SERVE_KV_DTYPE', 'bf16'),
+        help='KV-page storage dtype (needs --kv-page-size).  int8 '
+        'quantizes pages at scatter time (per-page absmax scale '
+        'stored alongside), halving the per-token KV read that '
+        'bounds decode throughput.  Serve specs set it via '
+        'service.kv_dtype (SKYTPU_SERVE_KV_DTYPE).')
+    parser.add_argument(
+        '--spec-ngram', type=int,
+        default=int(os.environ.get('SKYTPU_SERVE_SPEC_NGRAM', '0')),
+        help='self-speculative n-gram decoding: draft length k per '
+        'verify step (needs --kv-page-size; 0 = off).  The engine '
+        'drafts k tokens from each request\'s own history and '
+        'verifies all k+1 positions in one fixed-shape dispatch.  '
+        'Serve specs set it via service.speculation '
+        '(SKYTPU_SERVE_SPEC_NGRAM).')
+    parser.add_argument(
         '--role', choices=('monolithic', 'prefill', 'decode'),
         default=os.environ.get('SKYTPU_SERVE_ROLE', 'monolithic'),
         help='disaggregated serving role (requires --kv-page-size: '
@@ -438,7 +455,11 @@ def main() -> None:
                      max_prompt_len=args.max_prompt_len or None,
                      kv_page_size=args.kv_page_size or None,
                      kv_pages=args.kv_pages or None,
-                     prefix_cache=bool(args.prefix_cache)))
+                     prefix_cache=bool(args.prefix_cache),
+                     kv_dtype=(args.kv_dtype
+                               if args.kv_page_size else 'bf16'),
+                     speculation=(args.spec_ngram
+                                  if args.kv_page_size else 0)))
     # Compile every prefill shape before taking traffic — a mid-burst
     # XLA compile would stall the whole decode batch for seconds.
     engine.prewarm()
@@ -455,6 +476,10 @@ def main() -> None:
                 f'kv_page_size={args.kv_page_size or "off"}, '
                 f'prefix_cache='
                 f'{bool(args.prefix_cache and args.kv_page_size)}, '
+                f'kv_dtype='
+                f'{args.kv_dtype if args.kv_page_size else "bf16"}, '
+                f'speculation='
+                f'{args.spec_ngram if args.kv_page_size else 0}, '
                 f'checkpoint={args.checkpoint or "random-init"})')
     web.run_app(build_app(engine, role=args.role), port=args.port,
                 print=None)

@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh
 
+from skypilot_tpu.inference import kv_quant
 from skypilot_tpu.ops import attention as attn_lib
 
 
@@ -312,45 +313,71 @@ class Attention(nn.Module):
         and ``page_table`` [B, pages_per_slot] maps each slot's logical
         page index -> physical page, so a slot's sequence lives in
         whatever pages the host allocator handed it — shared prefix
-        pages included.  Each step scatter-writes one row into the
-        slot's CURRENT page (always slot-owned: shared pages end at the
+        pages included.  Each step scatter-writes S rows into the
+        slot's OWN pages (always slot-owned: shared pages end at the
         match boundary and writes only happen past it), then gathers
         the slot's pages back into position order and attends exactly
         like the dense path — same shapes, same masks, so greedy
         outputs are token-identical to the unpaged engine.
 
-        Steady-state decode only (S == 1): prefill and chunked prefill
-        run against dense per-request caches and are PAGED only at
-        insert time (engine-side scatters).  The pool shards over its
-        kv-heads dim under tensor parallelism; page ids index the
-        unsharded dim 0, so gathers and scatters stay local to each
-        chip's head shard.
+        S == 1 is the steady-state decode step; S > 1 is speculative
+        VERIFY: k drafted tokens plus the committed last token score in
+        one dispatch, each row position-scattered into its page exactly
+        like the chunked-prefill path, attending causally over the
+        gathered pages (earlier draft rows included — all writes land
+        before the gather).  Rejected draft rows leave K/V garbage at
+        positions past the accepted length; the causal mask keeps it
+        unread until the accepted stream overwrites it, the same
+        invariant that makes bucket-padded prefill safe.
+
+        When the pool is int8 (``kv_quant.QuantPages``), rows are
+        quantized at scatter time (one absmax scale per position) and
+        dequantized inside the gather — the attention matmul itself is
+        unchanged.  The pool shards over its kv-heads dim under tensor
+        parallelism; page ids index the unsharded dim 0, so gathers and
+        scatters stay local to each chip's head shard.
         """
         cfg = self.cfg
-        if not self.has_variable('cache', 'k') or q.shape[2] != 1:
+        if not self.has_variable('cache', 'k'):
             raise ValueError(
                 'paged attention is the steady-state decode path: the '
-                'engine supplies the page pool as the cache and S == 1')
+                'engine supplies the page pool as the cache')
         ck = self.variable('cache', 'k', jnp.zeros, (), cfg.dtype)
         cv = self.variable('cache', 'v', jnp.zeros, (), cfg.dtype)
-        ps = ck.value.shape[2]
+        quant = isinstance(ck.value, kv_quant.QuantPages)
+        kd = ck.value.data if quant else ck.value
+        ps = kd.shape[2]
         b = q.shape[0]
         n_logical = page_table.shape[1] * ps
-        pos = positions[:, 0]                                # [B]
-        page_ids = jnp.take_along_axis(page_table, (pos // ps)[:, None],
-                                       axis=1)[:, 0]         # [B]
-        off = pos % ps
-        # Write this step's K/V at (page, in-page offset).  Distinct
-        # live slots never share their write page (allocator invariant);
-        # inactive slots all point at the trash page — duplicate-index
-        # garbage the masks below keep unread.
-        ck.value = ck.value.at[page_ids, :, off, :].set(k[:, :, 0, :])
-        cv.value = cv.value.at[page_ids, :, off, :].set(v[:, :, 0, :])
+        page_ids = jnp.take_along_axis(page_table, positions // ps,
+                                       axis=1)                # [B, S]
+        off = positions % ps                                  # [B, S]
+
+        # Write this step's K/V rows at (page, in-page offset).
+        # Distinct live slots never share their write pages (allocator
+        # invariant); inactive slots all point at the trash page —
+        # duplicate-index garbage the masks below keep unread.
+        def _scatter(pool, rows):
+            rows = rows.transpose(0, 2, 1, 3)     # [B, S, H, D]
+            if quant:
+                qd, s = kv_quant.quantize_kv(rows)
+                return kv_quant.QuantPages(
+                    pool.data.at[page_ids, :, off, :].set(qd),
+                    pool.scale.at[page_ids, :, off].set(s))
+            return pool.at[page_ids, :, off, :].set(rows)
+
+        ck.value = _scatter(ck.value, k)
+        cv.value = _scatter(cv.value, v)
 
         def _gather(pool):
-            g = pool[page_table]                 # [B, P, H, ps, D]
+            if quant:
+                g = kv_quant.dequantize_kv(
+                    pool.data[page_table], pool.scale[page_table],
+                    cfg.dtype)                   # [B, P, H, ps, D]
+            else:
+                g = pool[page_table]             # [B, P, H, ps, D]
             g = g.transpose(0, 2, 1, 3, 4)       # [B, H, P, ps, D]
-            return g.reshape(b, pool.shape[1], n_logical, pool.shape[3])
+            return g.reshape(b, g.shape[1], n_logical, g.shape[4])
 
         k_all, v_all = _gather(ck.value), _gather(cv.value)
         k_pos = jnp.arange(n_logical)[None, :]

@@ -53,21 +53,44 @@ class EngineCostModel:
     kv_dtype_bytes: int     # element width of the KV cache / page pool
     n_chips: int = 1
     chip: str = 'cpu'
+    # Quantization-scale overhead, bytes per token position across all
+    # layers (int8 pools store one f32 absmax scale per (layer, K|V,
+    # kv_head, position) alongside the int8 payload; 0.0 for dense
+    # pools).  Folded into kv_bytes_per_pos.
+    kv_scale_bytes_per_pos: float = 0.0
 
     @classmethod
     def from_engine_state(cls, cfg, param_leaves: Sequence,
                           cache_leaves: Sequence, n_chips: int = 1,
-                          chip: Optional[str] = None) -> 'EngineCostModel':
+                          chip: Optional[str] = None,
+                          kv_dtype: Optional[str] = None
+                          ) -> 'EngineCostModel':
         """Build from live engine state.  Reads only leaf METADATA
-        (shape/dtype) — never leaf values, so no device sync."""
+        (shape/dtype) — never leaf values, so no device sync.
+
+        ``kv_dtype``: the engine's DECLARED page-pool element type
+        ('bf16'/'int8').  The declaration is authoritative over leaf
+        inspection — an int8 pool's flat leaves interleave int8 data
+        with f32 scales, and inferring the width from whichever leaf
+        happens to come first would silently misreport bytes/token.
+        None (unpaged engines / direct callers) falls back to the
+        first cache leaf's element width, as before."""
         param_bytes = sum(l.size * l.dtype.itemsize for l in param_leaves)
-        kv_bytes = (cache_leaves[0].dtype.itemsize if cache_leaves
-                    else 2)
+        scale_bytes = 0.0
+        if kv_dtype is not None:
+            kv_bytes = {'bf16': 2, 'int8': 1}[kv_dtype]
+            if kv_dtype == 'int8':
+                # One f32 scale per (layer, K|V, kv_head, position).
+                scale_bytes = 2.0 * cfg.n_layers * cfg.n_kv_heads * 4
+        else:
+            kv_bytes = (cache_leaves[0].dtype.itemsize if cache_leaves
+                        else 2)
         return cls(n_params=cfg.num_params(), n_layers=cfg.n_layers,
                    dim=cfg.dim, n_kv_heads=cfg.n_kv_heads,
                    head_dim=cfg.head_dim, param_bytes=int(param_bytes),
                    kv_dtype_bytes=int(kv_bytes), n_chips=n_chips,
-                   chip=chip or flops_lib.chip_kind())
+                   chip=chip or flops_lib.chip_kind(),
+                   kv_scale_bytes_per_pos=scale_bytes)
 
     # ----- FLOPs -----------------------------------------------------
     def decode_flops_per_token(self, context_len: float) -> float:
@@ -79,9 +102,11 @@ class EngineCostModel:
 
     # ----- HBM bytes -------------------------------------------------
     def kv_bytes_per_pos(self) -> float:
-        """Bytes of K+V held per token position across all layers."""
+        """Bytes of K+V held per token position across all layers
+        (payload at the pool's element width + any quantization-scale
+        overhead)."""
         return (2.0 * self.n_layers * self.n_kv_heads * self.head_dim *
-                self.kv_dtype_bytes)
+                self.kv_dtype_bytes + self.kv_scale_bytes_per_pos)
 
     def decode_hbm_bytes_per_token(self, context_len: float,
                                    n_active: int) -> float:

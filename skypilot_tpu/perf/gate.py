@@ -149,6 +149,29 @@ def probe_serve() -> dict:
             'observed_over_projected': round(
                 observed_ms / projected_ms, 2) if projected_ms else None,
         })
+    # int8 KV probe: a fresh paged engine with quantized pages.  Its
+    # cost model is built from the engine's DECLARED kv_dtype (the
+    # wiring this check guards — a cost model that silently priced the
+    # int8 pool at bf16 width would disagree with the live gauge's
+    # roofline immediately), so live-vs-bench agreement here proves the
+    # quantized-width plumbing end to end.
+    q_engine = DecodeEngine(
+        model, params,
+        EngineConfig(n_slots=n_slots, steps_per_call=4,
+                     prefill_buckets=buckets, kv_page_size=8,
+                     kv_dtype='int8'))
+    q_warms = [q_engine.submit(p, 1) for p in prompts[:n_slots]]
+    while any(w.finished_at is None for w in q_warms):
+        q_engine.step()
+    q_engine.perf_window_s = 1e9
+    q_engine.perf_reset_window()
+    q_reqs = [q_engine.submit(p, new_tokens) for p in prompts]
+    while any(r.finished_at is None for r in q_reqs):
+        q_engine.step_pipelined()
+    q_engine.perf_window_s = 0.0
+    q_engine.step()
+    q_snap = q_engine.perf_snapshot() or {}
+    q_cm = q_engine.perf_cost_model
     return {
         'chip': cm.chip,
         'model': 'tiny',
@@ -159,6 +182,10 @@ def probe_serve() -> dict:
         'hbm_bytes_per_token_live': snap.get('hbm_bytes_per_token'),
         'hbm_bytes_per_token_bench': round(
             cm.decode_hbm_bytes_per_token(mean_ctx, n_slots), 1),
+        'hbm_bytes_per_token_live_int8': q_snap.get(
+            'hbm_bytes_per_token'),
+        'hbm_bytes_per_token_bench_int8': round(
+            q_cm.decode_hbm_bytes_per_token(mean_ctx, n_slots), 1),
         'arith_intensity': round(cm.arith_intensity(mean_ctx, n_slots), 4),
         'roofline': rows,
     }
@@ -229,6 +256,10 @@ def run(baseline_path: Optional[str] = None,
         'gauge-vs-bench-hbm-bytes-per-token',
         probe.get('hbm_bytes_per_token_live'),
         probe.get('hbm_bytes_per_token_bench')))
+    checks.append(_agreement_check(
+        'gauge-vs-bench-hbm-bytes-per-token-int8',
+        probe.get('hbm_bytes_per_token_live_int8'),
+        probe.get('hbm_bytes_per_token_bench_int8')))
 
     base_chip = _dig(detail, 'train.chip')
     base_model = _dig(detail, 'serve.model')

@@ -54,6 +54,8 @@ ENV_REPLICA_MAX_PROMPT = 'SKYTPU_SERVE_MAX_PROMPT_LEN'
 ENV_REPLICA_KV_PAGE = 'SKYTPU_SERVE_KV_PAGE_SIZE'
 ENV_REPLICA_KV_PAGES = 'SKYTPU_SERVE_KV_PAGES'
 ENV_REPLICA_PREFIX_CACHE = 'SKYTPU_SERVE_PREFIX_CACHE'
+ENV_REPLICA_KV_DTYPE = 'SKYTPU_SERVE_KV_DTYPE'
+ENV_REPLICA_SPEC_NGRAM = 'SKYTPU_SERVE_SPEC_NGRAM'
 # Disaggregated serving: the replica's pool role (prefill | decode),
 # read by the inference server as its --role default.
 ENV_REPLICA_ROLE = 'SKYTPU_SERVE_ROLE'
@@ -195,6 +197,13 @@ class ReplicaManager:
         if self.spec.prefix_cache is not None:
             envs[ENV_REPLICA_PREFIX_CACHE] = \
                 str(int(self.spec.prefix_cache))
+        if self.spec.kv_dtype is not None:
+            # --kv-dtype default: int8 page quantization — halves the
+            # per-token KV read on every replica's decode path.
+            envs[ENV_REPLICA_KV_DTYPE] = self.spec.kv_dtype
+        if self.spec.speculation is not None:
+            # --spec-ngram default: self-speculative draft length k.
+            envs[ENV_REPLICA_SPEC_NGRAM] = str(self.spec.speculation)
         task.update_envs(envs)
         res = task.any_resources
         overrides = {}

@@ -96,6 +96,18 @@ class ServiceSpec:
     # (on when paging is on).  Reaches the workload as
     # SKYTPU_SERVE_PREFIX_CACHE.
     prefix_cache: Optional[bool] = None
+    # KV-page storage dtype (needs kv_page_size): 'int8' quantizes
+    # pages at scatter time (per-page absmax scale stored alongside),
+    # halving decode's KV HBM traffic — the lever on bytes-per-token
+    # when decode is bandwidth-bound.  None = engine default ('bf16').
+    # Reaches the workload as SKYTPU_SERVE_KV_DTYPE.
+    kv_dtype: Optional[str] = None
+    # Self-speculative n-gram decoding (needs kv_page_size): draft
+    # length k per verify step — the engine drafts k tokens from the
+    # request's own history and verifies all of them in ONE fixed-shape
+    # dispatch, so accepted drafts amortize the per-step weight read.
+    # None / 0 = off.  Reaches the workload as SKYTPU_SERVE_SPEC_NGRAM.
+    speculation: Optional[int] = None
     # Latency SLO targets (milliseconds): with either set, the
     # controller runs the SLOAutoscaler — scale up on p95 TTFT/TPOT
     # violation measured from the LB's federated histograms, scale down
@@ -155,6 +167,18 @@ class ServiceSpec:
             raise exceptions.InvalidTaskError(
                 'service.kv_pages requires service.kv_page_size '
                 '(it sizes the paged pool)')
+        kv_dtype = config.get('kv_dtype')
+        if kv_dtype is not None and kv_page_size is None:
+            raise exceptions.InvalidTaskError(
+                'service.kv_dtype requires service.kv_page_size '
+                '(quantization happens at page-scatter time)')
+        spec_raw = config.get('speculation')
+        speculation = int(spec_raw) if spec_raw is not None else None
+        if speculation and kv_page_size is None:
+            raise exceptions.InvalidTaskError(
+                'service.speculation requires service.kv_page_size '
+                '(the verify dispatch scatters drafts through the '
+                'page table)')
         shed_raw = config.get('max_queue_tokens_per_replica')
         max_queue_tokens = int(shed_raw) if shed_raw is not None else None
         if max_queue_tokens is not None and max_queue_tokens <= 0:
@@ -206,6 +230,8 @@ class ServiceSpec:
                        kv_page_size=kv_page_size,
                        kv_pages=kv_pages,
                        prefix_cache=prefix_cache,
+                       kv_dtype=kv_dtype,
+                       speculation=speculation,
                        max_queue_tokens_per_replica=max_queue_tokens,
                        disaggregation=disaggregation)
         min_r = int(policy.get('min_replicas', 1))
@@ -263,6 +289,8 @@ class ServiceSpec:
             kv_page_size=kv_page_size,
             kv_pages=kv_pages,
             prefix_cache=prefix_cache,
+            kv_dtype=kv_dtype,
+            speculation=speculation,
             target_ttft_ms=(float(target_ttft)
                             if target_ttft is not None else None),
             target_tpot_ms=(float(target_tpot)
@@ -315,6 +343,10 @@ class ServiceSpec:
             out['kv_pages'] = self.kv_pages
         if self.prefix_cache is not None:
             out['prefix_cache'] = self.prefix_cache
+        if self.kv_dtype is not None:
+            out['kv_dtype'] = self.kv_dtype
+        if self.speculation is not None:
+            out['speculation'] = self.speculation
         if self.max_queue_tokens_per_replica is not None:
             out['max_queue_tokens_per_replica'] = \
                 self.max_queue_tokens_per_replica

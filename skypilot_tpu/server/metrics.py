@@ -103,6 +103,24 @@ _HELP = {
         'KV handoffs adopted into this engine\'s page pool (decode '
         'role): pages scattered at page granularity, decode continued '
         'from the transferred first token — no per-token recompute',
+    'skytpu_engine_kv_quant_pages_total':
+        'KV pages written to the pool int8-quantized (kv_dtype=int8: '
+        'symmetric absmax along head_dim at scatter time, dequantized '
+        'inside the attention gather) — real pages only, trash-page '
+        'scribbles excluded',
+    'skytpu_engine_spec_proposed_tokens_total':
+        'Draft tokens proposed by the self-speculative n-gram '
+        'proposer (k per active slot per verify dispatch)',
+    'skytpu_engine_spec_accepted_tokens_total':
+        'Draft tokens accepted by the verify dispatch (longest '
+        'greedy-matching prefix; every verify commits at least the '
+        'one token plain decode would have — accepted counts only '
+        'the EXTRA tokens drafts bought)',
+    'skytpu_engine_spec_acceptance':
+        'Draft acceptance rate of the latest verify step (accepted / '
+        'proposed, 0..1): the health signal of speculative decoding '
+        '— near 0 the engine is doing plain decode plus wasted '
+        'verify columns, near 1 each dispatch commits k+1 tokens',
     'skytpu_engine_batch_occupancy_ratio':
         'Active decode slots / total slots, sampled each loop step',
     'skytpu_engine_active_slots': 'Decode slots occupied this step',

@@ -112,6 +112,12 @@ SPAN_HELP = {
         'Decode-role admission scattered a KV handoff\'s pages into '
         'the local pool and seeded the slot from the transferred '
         'first token — occupies the prefill slot of the TTFT tiling',
+    'engine.verify':
+        'One speculative verify dispatch covering this request\'s '
+        'slot: k n-gram-drafted tokens scored in a single fixed-shape '
+        'call (attrs: proposed, accepted).  A decode-phase span — '
+        'NOT part of the TTFT tiling, which first_token closes before '
+        'any verify runs',
     # ----- device-level perf observability (perf/) -------------------------
     'perf.recompile':
         'Post-warmup XLA compile caught by the runtime recompile '
@@ -295,6 +301,16 @@ def decompose(events: List[dict]) -> dict:
     if first is not None and first['attrs'].get('ttft_s') is not None:
         ttft_ms = round(first['attrs']['ttft_s'] * 1e3, 4)
     decomposed = round(queue + prefill + dispatch, 4)
+    # Decode-phase speculation attribution (engine.verify spans are
+    # NOT part of the TTFT tiling — first_token closes before any
+    # verify dispatch covers this request).
+    verify = durs('engine.verify')
+    spec_proposed = sum(
+        e['attrs'].get('proposed') or 0 for e in events
+        if e['name'] == 'engine.verify')
+    spec_accepted = sum(
+        e['attrs'].get('accepted') or 0 for e in events
+        if e['name'] == 'engine.verify')
     route = next((e for e in events if e['name'] == 'lb.route'), None)
     outcome = 'ok'
     if any(e['name'] == 'lb.shed' for e in events):
@@ -319,6 +335,10 @@ def decompose(events: List[dict]) -> dict:
         'decomposed_ttft_ms': decomposed,
         'unattributed_ms': (round(ttft_ms - decomposed, 4)
                             if ttft_ms is not None else None),
+        'verify_ms': round(sum(verify), 4),
+        'verify_calls': len(verify),
+        'spec_proposed_tokens': spec_proposed,
+        'spec_accepted_tokens': spec_accepted,
         'emitted_tokens': (end or {}).get('attrs', {}).get('emitted'),
     }
 

@@ -113,6 +113,14 @@ _SERVICE_SCHEMA: Dict[str, Any] = {
         # Radix prefix cache over the paged pool (requires
         # kv_page_size; plumbed as SKYTPU_SERVE_PREFIX_CACHE).
         'prefix_cache': {'type': 'boolean'},
+        # KV-page storage dtype (requires kv_page_size; plumbed as
+        # SKYTPU_SERVE_KV_DTYPE).  'int8' halves KV HBM traffic by
+        # quantizing pages at scatter time (per-page absmax scale).
+        'kv_dtype': {'enum': ['bf16', 'int8']},
+        # Self-speculative n-gram decoding: draft length k per verify
+        # step (requires kv_page_size; plumbed as
+        # SKYTPU_SERVE_SPEC_NGRAM).  0 / omitted = off.
+        'speculation': {'type': 'integer', 'minimum': 0},
         # Queue-aware load shedding at the LB: when every ready
         # replica's engine backlog (queued prefill tokens, from the
         # federated gauges / replica response headers) is at or above

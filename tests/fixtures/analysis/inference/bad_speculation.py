@@ -1,0 +1,24 @@
+"""Known-bad fixture for the speculation rule: the verify dispatch
+must stay a fixed, pinned program.
+
+Both findings model real regressions: (1) building a jit wrapper
+inside the propose/verify loop (a per-step compile stall hidden from
+the loop-based recompile rule — the function is called every step but
+is not lexically inside a loop), and (2) wiring the verify program
+without pinned shardings / donated state, so the page pool it carries
+double-buffers and placement drift recompiles mid-traffic.
+"""
+import jax
+
+
+def _propose_and_verify(drafts):
+    # BAD: a fresh jit wrapper per verify call — the compile cache
+    # keys on wrapper identity, so every engine step compiles.
+    scorer = jax.jit(lambda x: x)
+    return scorer(drafts)
+
+
+def make_engine(verify_step):
+    # BAD: the verify program carries the page pool but pins nothing
+    # and donates nothing.
+    return jax.jit(verify_step)

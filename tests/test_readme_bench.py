@@ -238,6 +238,57 @@ def test_readme_disagg_claims_pinned():
         f'expected {want}')
 
 
+def test_readme_speculative_claims_pinned():
+    """Speculative-decoding claims are mechanical, both directions:
+    once an artifact carries serve.speculative, draft acceptance on the
+    repetitive workload must exceed the random (incompressible)
+    workload — acceptance IS the mechanism, so an inversion means the
+    n-gram proposer is broken — the int8 grid must show a lower
+    per-token HBM read than bf16, and the README's numeric claims must
+    match the artifact; before an artifact carries it, the README may
+    not invent the numbers."""
+    path, parsed = _latest_bench()
+    spec = (parsed['detail'].get('serve') or {}).get('speculative')
+    with open(os.path.join(_ROOT, 'README.md'), encoding='utf-8') as f:
+        readme = ' '.join(f.read().split())
+    found_tok = re.findall(r'([0-9.]+) out-tok/s \(speculative', readme)
+    found_tpot = re.findall(r'speculative TPOT ([0-9.]+) ms', readme)
+    found_acc = re.findall(
+        r'draft acceptance ([0-9.]+) \(repetitive\) vs '
+        r'([0-9.]+) \(random\)', readme)
+    if not spec or spec.get('out_tok_per_s_spec') is None:
+        assert not (found_tok or found_tpot or found_acc), (
+            f'README claims a speculative-decoding result '
+            f'({found_tok + found_tpot + found_acc}) but the latest '
+            f'bench artifact {path} has no serve.speculative scenario')
+        return
+    # The acceptance criteria, held mechanically on the artifact:
+    assert spec['acceptance_repetitive'] > spec['acceptance_random'], (
+        f'{path}: repetitive-traffic draft acceptance must exceed the '
+        f'incompressible baseline — the n-gram proposer is not '
+        f'proposing')
+    assert spec['hbm_bytes_per_token_int8'] < \
+        spec['hbm_bytes_per_token_bf16'], (
+            f'{path}: int8 KV pages must lower the per-token HBM read')
+    serve = parsed['detail']['serve']
+    assert spec['out_tok_per_s_spec'] > serve['out_tok_per_s'], (
+        f'{path}: the speculative headline must beat the plain-serve '
+        f'headline in the same artifact')
+    want_tok = f"{spec['out_tok_per_s_spec']:.1f}"
+    want_tpot = f"{spec['tpot_spec_ms']:.2f}"
+    want_acc = (f"{spec['acceptance_repetitive']:.2f}",
+                f"{spec['acceptance_random']:.2f}")
+    assert found_tok and all(v == want_tok for v in found_tok), (
+        f'README speculative out-tok/s claim {found_tok} drifted from '
+        f'{path}: expected {want_tok}')
+    assert found_tpot and all(v == want_tpot for v in found_tpot), (
+        f'README speculative TPOT claim {found_tpot} drifted from '
+        f'{path}: expected {want_tpot}')
+    assert found_acc and all(f == want_acc for f in found_acc), (
+        f'README draft-acceptance claim {found_acc} drifted from '
+        f'{path}: expected {want_acc}')
+
+
 def test_readme_fleet_claims_pinned():
     """The fleet-scale simulation claim is mechanical, both directions:
     once an artifact carries detail.fleet, the README must quote the

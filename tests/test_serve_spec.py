@@ -1,0 +1,390 @@
+"""Speculative decoding + int8 quantized KV pages.
+
+The parity contract (the acceptance criterion): greedy speculation is
+LOSSLESS — a spec-on engine (any draft length k) produces output
+token-identical to the spec-off engine, single-device and under the
+virtual tensor=2 mesh, through chunked prompts, prefix-cache hits and
+multi-turn replays.  Verify accepts exactly the tokens plain decode
+would have sampled, so the only thing speculation may change is how
+many dispatches it took to emit them.
+
+The quantization contract: int8 pages round-trip through scatter/
+gather (and the disagg KV handoff) with per-element error bounded by
+half a quantization step, and the spec-on int8 engine still matches
+its own spec-off twin exactly.
+
+The perf contracts: zero recompiles and one device->host sync per step
+hold with speculation active — the verify program is built once per
+engine and every draft batch reuses it.
+
+Float32 compute for all cross-program comparisons, per the
+test_serve_sharded.py precedent.
+"""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.inference import kv_quant, kv_transfer
+from skypilot_tpu.inference.engine import (DecodeEngine, EngineConfig,
+                                           _ngram_continuation)
+from skypilot_tpu.models.llama import LLAMA_CONFIGS, Llama, init_params
+from skypilot_tpu.parallel.mesh import build_serve_mesh
+from skypilot_tpu.server import metrics as metrics_lib
+
+CFG = dataclasses.replace(LLAMA_CONFIGS['tiny'], dtype=jnp.float32)
+PS = 8     # page size: divides the buckets (8, 16) and max_seq_len
+_PROMPT_RNG = np.random.default_rng(37)
+
+
+@pytest.fixture(scope='module')
+def params():
+    return init_params(Llama(CFG), jax.random.PRNGKey(0))['params']
+
+
+@pytest.fixture(scope='module')
+def cyclic_params(params):
+    """Repetitive-traffic proxy: scaling params toward zero flattens
+    the logits' context dependence, so greedy generation locks into
+    short cycles — the regime n-gram drafts always hit."""
+    return jax.tree.map(lambda x: (x * 0.1).astype(x.dtype), params)
+
+
+@pytest.fixture(autouse=True)
+def _reset_metrics():
+    metrics_lib.reset_for_tests()
+    yield
+    metrics_lib.reset_for_tests()
+
+
+def make_engine(params, tensor=1, **overrides):
+    mesh = None
+    if tensor > 1:
+        mesh = build_serve_mesh(tensor, n_heads=CFG.n_heads,
+                                n_kv_heads=CFG.n_kv_heads)
+    kw = dict(n_slots=2, prefill_buckets=(8, 16), steps_per_call=3,
+              kv_page_size=PS)
+    kw.update(overrides)
+    return DecodeEngine(Llama(CFG, mesh), params,
+                        EngineConfig(mesh=mesh, **kw))
+
+
+def run(engine, req, max_steps=2000):
+    while req.finished_at is None:
+        engine.step()
+        max_steps -= 1
+        assert max_steps > 0, 'request never finished'
+    engine.drain()
+    return req.tokens()
+
+
+def prompt_of(n):
+    return _PROMPT_RNG.integers(1, CFG.vocab_size, n).tolist()
+
+
+def _counter(family):
+    from skypilot_tpu.serve import metrics_math
+    return metrics_math.counter_total(
+        metrics_math.parse_samples(metrics_lib.render()), family)
+
+
+# ----- the n-gram proposer ----------------------------------------------------
+def test_ngram_continuation_drafts_cycles():
+    """Longest-n-first match, cyclic extension past the end of history
+    (a period-p loop must draft the whole next k, not p then zeros),
+    and self-rejection (zeros) when history has no repeated tail."""
+    # Period-1 cycle: the overlapping match spans one token — the
+    # draft must repeat it k times, not zero-pad after one.
+    assert _ngram_continuation([5, 9, 34, 34, 34], 4).tolist() == [34] * 4
+    # Period-3 cycle drafts the cycle, phase-correct.
+    assert _ngram_continuation([1, 2, 3] * 4, 5).tolist() == [1, 2, 3, 1, 2]
+    # Non-overlapping earlier occurrence: drafts its true continuation.
+    assert _ngram_continuation(
+        [9, 8, 7, 1, 2, 3, 4, 5, 6, 9, 8, 7], 4).tolist() == [1, 2, 3, 4]
+    # Longest n wins: tail [8, 7] matches before tail [7] alone.
+    assert _ngram_continuation(
+        [8, 7, 5, 5, 3, 7, 6, 6, 8, 7], 2).tolist() == [5, 5]
+    # Incompressible history: zeros (verify self-rejects to m=1).
+    assert _ngram_continuation(list(range(20)), 3).tolist() == [0, 0, 0]
+    assert _ngram_continuation([4], 3).tolist() == [0, 0, 0]
+
+
+# ----- greedy parity ----------------------------------------------------------
+@pytest.mark.parametrize('plen', [7, 13, 16, 40])
+@pytest.mark.parametrize('k', [2, 4])
+def test_spec_parity_single_device(params, plen, k):
+    """Fused-bucket, partial-page, page-aligned and CHUNKED prompts:
+    the spec-on engine emits the exact spec-off stream."""
+    prompt = prompt_of(plen)
+    base = make_engine(params)
+    ref = run(base, base.submit(prompt, 12))
+    spec = make_engine(params, speculation=k)
+    assert run(spec, spec.submit(prompt, 12)) == ref
+
+
+def test_spec_parity_tensor2(params):
+    """Verify's jit is pinned over the mesh (sharded pool donated,
+    replicated tokens): tensor=2 output matches single-device."""
+    prompt = prompt_of(13)
+    base = make_engine(params)
+    ref = run(base, base.submit(prompt, 12))
+    spec = make_engine(params, tensor=2, speculation=4)
+    spec.prewarm()
+    assert run(spec, spec.submit(prompt, 12)) == ref
+
+
+def test_spec_parity_prefix_hit_and_multiturn(params):
+    """Speculation composes with the radix cache: a prefix-hit
+    admission followed by speculative decode, then a multi-turn replay
+    over the generated pages — all token-identical to spec-off."""
+    shared = prompt_of(16)
+    tail = prompt_of(4)
+    hit_tail = prompt_of(3)
+    turn = prompt_of(2)
+
+    def transcript(k):
+        engine = make_engine(params, n_slots=2, kv_pages=40,
+                             speculation=k)
+        first = run(engine, engine.submit(shared + tail, 8))
+        hit = run(engine, engine.submit(shared + hit_tail, 8))
+        # Multi-turn: the full first conversation comes back with a
+        # new user turn appended — its prompt+generated pages hit.
+        replay = run(engine, engine.submit(
+            shared + tail + first + turn, 8))
+        return first, hit, replay
+
+    assert transcript(4) == transcript(0)
+    assert _counter('skytpu_engine_prefix_cache_hits_total') > 0
+
+
+@pytest.mark.parametrize('k', [0, 3])
+def test_spec_parity_int8(params, k):
+    """int8 pages with and without speculation: spec-on matches the
+    int8 spec-off twin exactly (quantization error is identical on
+    both sides — verify replays the same gather plain decode does)."""
+    prompt = prompt_of(13)
+    base = make_engine(params, kv_dtype='int8')
+    ref = run(base, base.submit(prompt, 12))
+    spec = make_engine(params, kv_dtype='int8', speculation=k or 4)
+    assert run(spec, spec.submit(prompt, 12)) == ref
+
+
+# ----- acceptance accounting --------------------------------------------------
+def test_spec_acceptance_repetitive_exceeds_random(params, cyclic_params):
+    """Acceptance-rate sanity: cycling greedy output (repetitive-
+    traffic proxy) must accept a large fraction of drafts; chaotic
+    output (stock random-init params) must accept almost none.  Both
+    ride the same counters the /metrics gauge is derived from."""
+
+    def acceptance(p):
+        engine = make_engine(p, n_slots=2, speculation=4)
+        before_p = _counter('skytpu_engine_spec_proposed_tokens_total')
+        before_a = _counter('skytpu_engine_spec_accepted_tokens_total')
+        for _ in range(2):
+            run(engine, engine.submit(prompt_of(12), 48))
+        proposed = _counter(
+            'skytpu_engine_spec_proposed_tokens_total') - before_p
+        accepted = _counter(
+            'skytpu_engine_spec_accepted_tokens_total') - before_a
+        assert proposed > 0 and 0 <= accepted <= proposed
+        return accepted / proposed
+
+    rep = acceptance(cyclic_params)
+    rand = acceptance(params)
+    assert rep > 0.3, f'cycling traffic accepted only {rep:.3f}'
+    assert rand < rep, (rand, rep)
+    # The derived gauge is exported and help-annotated.
+    text = metrics_lib.render()
+    assert 'skytpu_engine_spec_acceptance' in text
+    assert '# HELP skytpu_engine_spec_proposed_tokens_total' in text
+
+
+def test_spec_off_engine_exports_no_spec_counters(params):
+    """A spec-off engine must not touch the speculation counters —
+    they would read as 0/0 acceptance and pollute the fleet view."""
+    engine = make_engine(params)
+    run(engine, engine.submit(prompt_of(9), 6))
+    assert _counter('skytpu_engine_spec_proposed_tokens_total') == 0
+
+
+# ----- perf contracts ---------------------------------------------------------
+def test_spec_zero_recompiles_mixed_traffic(params):
+    """After one warmup pass the verify program is cached per engine;
+    mixed traffic — chunked prompts, prefix hits, fused buckets —
+    must never add a compiled-call cache entry."""
+    engine = make_engine(params, speculation=4)
+    shared = prompt_of(12)
+    warm = [engine.submit(prompt_of(40), 4),    # chunks + insert
+            engine.submit(prompt_of(5), 4),     # fused bucket 8
+            engine.submit(shared + [1], 4)]     # publishes prefix
+    for r in warm:
+        run(engine, r)
+    hit = engine.submit(shared + [2, 3], 4)
+    run(engine, hit)
+    fns = [engine._decode, engine._verify, engine._prefill_insert,
+           engine._prefill_chunk, engine._chunk_insert]
+    sizes = [f._cache_size() for f in fns]
+    traffic = [engine.submit(prompt_of(55), 5),
+               engine.submit(shared + [9], 5),
+               engine.submit(prompt_of(7), 5)]
+    for r in traffic:
+        run(engine, r)
+    assert [f._cache_size() for f in fns] == sizes
+
+
+def test_spec_one_sync_per_step(params, monkeypatch):
+    """Speculation keeps the one-fetch-per-step contract: drafts ship
+    host->device async inside the dispatch, and the acceptance counts
+    ride the SAME fetched array as the tokens (no second sync)."""
+    import numpy as real_np
+    from skypilot_tpu.inference import engine as engine_mod
+
+    class _Counting:
+        def __init__(self, real):
+            self._real = real
+            self.asarray_calls = 0
+
+        def __getattr__(self, name):
+            return getattr(self._real, name)
+
+        def asarray(self, *args, **kwargs):
+            self.asarray_calls += 1
+            return self._real.asarray(*args, **kwargs)
+
+    counting = _Counting(real_np)
+    monkeypatch.setattr(engine_mod, 'np', counting)
+    engine = make_engine(params, speculation=4)
+    active_steps = 0
+    req = engine.submit(prompt_of(9), 8)
+    while req.finished_at is None:
+        if engine.step() > 0:
+            active_steps += 1
+    assert req.tokens()
+    assert counting.asarray_calls == active_steps
+
+
+# ----- int8 quantization ------------------------------------------------------
+def test_quantize_kv_error_bounded_and_idempotent():
+    """Symmetric absmax int8: per-element error <= half a quantization
+    step of its page row, and re-quantizing the dequantized values is
+    exact (the invariant that makes shared-prefix write-back and
+    KV-handoff round-trips value-stable)."""
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(0, 3, (4, 2, 8, 16)).astype(np.float32))
+    q, s = kv_quant.quantize_kv(x)
+    assert q.dtype == jnp.int8 and s.dtype == jnp.float32
+    dq = kv_quant.dequantize_kv(q, s, jnp.float32)
+    err = np.abs(np.asarray(x) - np.asarray(dq))
+    assert np.all(err <= np.asarray(s)[..., None] * 0.5 + 1e-6)
+    q2, s2 = kv_quant.quantize_kv(dq)
+    assert np.array_equal(np.asarray(q), np.asarray(q2))
+    assert np.allclose(np.asarray(s), np.asarray(s2), rtol=1e-6)
+
+
+def test_int8_divergence_bounded_one_step(params):
+    """Model-level quantization error bound: one decode step over an
+    int8 pool stays close to the f32 pool's logits — small relative
+    error, same argmax on this workload (the engine-level parity tests
+    above depend on exactly this margin)."""
+    prompt = prompt_of(12)
+    outs = {}
+    for dtype in ('bf16', 'int8'):
+        engine = make_engine(params, kv_dtype=dtype)
+        outs[dtype] = run(engine, engine.submit(prompt, 8))
+    # tiny/f32 random weights: logit gaps dwarf the int8 step, so the
+    # greedy streams agree token-for-token.
+    assert outs['int8'] == outs['bf16']
+
+
+def test_int8_handoff_roundtrip_checksum(params):
+    """Disaggregated handoff of a QUANTIZED pool: exported leaves
+    alternate int8 page data and f32 scales, the serialized payload
+    round-trips bit-exact, and the adopting spec-enabled engine
+    produces the monolithic int8 stream."""
+    prompt = prompt_of(13)
+    mono = make_engine(params, kv_dtype='int8')
+    ref = run(mono, mono.submit(prompt, 12))
+
+    a = make_engine(params, kv_dtype='int8')
+    b = make_engine(params, kv_dtype='int8', speculation=4)
+    ra = a.submit_prefill(prompt, 12)
+    first = run(a, ra)
+    exported = a.export_result(ra)
+    dtypes = {np.asarray(leaf).dtype.name for leaf in exported['leaves']}
+    assert dtypes == {'int8', 'float32'}, dtypes
+    payload = kv_transfer.serialize(kv_transfer.KVHandoff(
+        prompt_ids=prompt, first_token=exported['first_token'],
+        max_new_tokens=12, page_size=PS, leaves=exported['leaves']))
+    h = kv_transfer.deserialize(payload)
+    for sent, got in zip(exported['leaves'], h.leaves):
+        assert np.array_equal(np.asarray(sent), np.asarray(got))
+        assert np.asarray(sent).dtype == np.asarray(got).dtype
+    rb = b.submit_adopt(h.prompt_ids, h.first_token, h.leaves,
+                        h.max_new_tokens, page_size=h.page_size)
+    assert first == [ref[0]]
+    assert run(b, rb) == ref
+
+
+# ----- config validation ------------------------------------------------------
+def test_engine_config_rejects_bad_spec_knobs(params):
+    with pytest.raises(ValueError, match='kv_dtype'):
+        make_engine(params, kv_page_size=None, kv_dtype='int8')
+    with pytest.raises(ValueError, match='kv_dtype'):
+        make_engine(params, kv_dtype='fp8')
+    with pytest.raises(ValueError, match='speculation'):
+        make_engine(params, kv_page_size=None, speculation=2)
+    with pytest.raises(ValueError, match='non-negative'):
+        make_engine(params, speculation=-1)
+    with pytest.raises(ValueError, match='greedy'):
+        make_engine(params, speculation=2, temperature=0.7)
+
+
+# ----- serve-spec plumbing ----------------------------------------------------
+def test_service_spec_spec_knobs_roundtrip():
+    from skypilot_tpu.serve.service_spec import ServiceSpec
+    spec = ServiceSpec.from_yaml_config({
+        'readiness_probe': '/health', 'replicas': 1,
+        'kv_page_size': 16, 'kv_dtype': 'int8', 'speculation': 4})
+    assert spec.kv_dtype == 'int8' and spec.speculation == 4
+    again = ServiceSpec.from_yaml_config(spec.to_yaml_config())
+    assert again.kv_dtype == 'int8' and again.speculation == 4
+
+
+def test_service_spec_spec_knobs_require_paging():
+    from skypilot_tpu import exceptions
+    from skypilot_tpu.serve.service_spec import ServiceSpec
+    with pytest.raises(exceptions.InvalidTaskError, match='kv_dtype'):
+        ServiceSpec.from_yaml_config({
+            'readiness_probe': '/health', 'kv_dtype': 'int8'})
+    with pytest.raises(exceptions.InvalidTaskError, match='speculation'):
+        ServiceSpec.from_yaml_config({
+            'readiness_probe': '/health', 'speculation': 3})
+    with pytest.raises(exceptions.InvalidTaskError, match='kv_dtype'):
+        ServiceSpec.from_yaml_config({
+            'readiness_probe': '/health', 'kv_page_size': 16,
+            'kv_dtype': 'fp4'})
+
+
+def test_replica_task_env_carries_spec_knobs():
+    import skypilot_tpu.task as task_lib
+    from skypilot_tpu.serve import replica_managers as rm
+    from skypilot_tpu.serve.service_spec import ServiceSpec
+    spec = ServiceSpec.from_yaml_config({
+        'readiness_probe': '/health', 'replicas': 1,
+        'kv_page_size': 16, 'kv_dtype': 'int8', 'speculation': 4})
+    mgr = rm.ReplicaManager.__new__(rm.ReplicaManager)
+    mgr.service_name = 'svc'
+    mgr.spec = spec
+    mgr.task = task_lib.Task(run='echo serve', name='w')
+    task = mgr._replica_task(0, 8200, None, False)
+    assert task.envs[rm.ENV_REPLICA_KV_DTYPE] == 'int8'
+    assert task.envs[rm.ENV_REPLICA_SPEC_NGRAM] == '4'
+    # Omitted knobs stay unset: the server's env defaults apply.
+    bare = ServiceSpec.from_yaml_config({
+        'readiness_probe': '/health', 'replicas': 1})
+    mgr.spec = bare
+    task = mgr._replica_task(0, 8200, None, False)
+    assert rm.ENV_REPLICA_KV_DTYPE not in task.envs
+    assert rm.ENV_REPLICA_SPEC_NGRAM not in task.envs

@@ -178,6 +178,15 @@ def test_fixture_findings_land_where_expected():
     fleet_msgs = ' '.join(f.message for f in fleet_hits)
     assert 'skytpu_fleetsim_tick_millis' in fleet_msgs
     assert 'skytpu_fleetsim_rogue_total' in fleet_msgs
+    # speculation: the jit-inside-propose/verify hazard AND the
+    # unpinned verify program — both from the speculation fixture,
+    # and ONLY from it (the engine's real verify wiring is clean).
+    spec = by_rule['speculation']
+    assert {f.path for f in spec} == {'inference/bad_speculation.py'}
+    assert len(spec) == 2
+    spec_msgs = ' '.join(f.message for f in spec)
+    assert 'defeats the compile cache' in spec_msgs
+    assert 'without pinned' in spec_msgs
 
 
 # ---------------------------------------------------------------------------
