@@ -732,6 +732,131 @@ def bench_trace_overhead(on_tpu: bool) -> dict:
     }
 
 
+def bench_obs_overhead(on_tpu: bool) -> dict:
+    """Cost of the fleet telemetry plane (skypilot_tpu/obs).
+
+    Backs the "<1% serving-throughput overhead" contract
+    (test_readme_bench pins it once this lands in an artifact).  The
+    plane touches serving in exactly two places, measured separately:
+
+      - us_per_ingest: a full scrape -> counter-reset-aware downsample
+        -> store transaction on a realistic mixed-pool exposition.
+        The CONTROLLER pays this once per tick, off the serving path;
+        ingest_duty_pct is that cost over the default resolution — the
+        fraction of one controller core the store consumes.
+      - ns_per_digest: the crc32 path-digest + XOR the ENGINE pays per
+        radix-cache insert/evict for the prefix-fingerprint gauge —
+        the only on-serving-path addition.
+      - overhead_pct: the headline — engine-side additive work per
+        generated token over the measured per-token budget, same
+        derivation as the tracing bench (strictly additive work on the
+        loop thread, so the product IS the overhead; a differential
+        run would be jitter-dominated at this magnitude).
+    """
+    import os
+    import tempfile
+    import zlib
+    from skypilot_tpu.inference.engine import DecodeEngine, EngineConfig
+    from skypilot_tpu.models.llama import LLAMA_CONFIGS, Llama, init_params
+    from skypilot_tpu.obs import store as obs_store
+    from skypilot_tpu.server import metrics as metrics_lib
+
+    # A realistic federated exposition: 8 replicas across two pools
+    # with latency histograms, traffic counters, and engine gauges.
+    metrics_lib.reset_for_tests()
+    rng = np.random.default_rng(0)
+    for i in range(8):
+        rid = str(i)
+        for _ in range(40):
+            metrics_lib.observe_hist(metrics_lib.ENGINE_TTFT_FAMILY,
+                                     float(rng.uniform(0.05, 0.4)),
+                                     replica=rid)
+            metrics_lib.observe_hist(metrics_lib.ENGINE_TPOT_FAMILY,
+                                     float(rng.uniform(0.01, 0.04)),
+                                     replica=rid)
+        metrics_lib.inc_counter('skytpu_lb_requests_total', 40.0)
+        metrics_lib.set_gauge('skytpu_engine_kv_free_pages', 512.0,
+                              replica=rid)
+        metrics_lib.set_gauge('skytpu_engine_prefix_fingerprint',
+                              float(i * 2654435761 % 2**32),
+                              replica=rid)
+    text = metrics_lib.render()
+    metrics_lib.reset_for_tests()
+
+    db = os.path.join(tempfile.mkdtemp(prefix='skytpu-bench-obs-'),
+                      'obs.db')
+    store = obs_store.TelemetryStore(db, resolution=1.0)
+    roles = {str(i): ('prefill' if i < 2 else 'decode')
+             for i in range(8)}
+    now0 = 1_000_000.0
+    store.ingest('bench', text, now=now0, leader_check=False)  # warmup
+    per_call = []
+    for batch in range(5):
+        t0 = time.perf_counter()
+        for i in range(20):
+            store.ingest('bench', text, now=now0 + batch * 20 + i + 1,
+                         roles=roles, leader_check=False)
+        per_call.append((time.perf_counter() - t0) / 20 * 1e6)
+    us_per_ingest = min(per_call)
+    ingest_duty_pct = (us_per_ingest * 1e-6 /
+                       obs_store.DEFAULT_RESOLUTION_S * 100.0)
+
+    # ns/digest: the per-insert fingerprint cost, microbenched exactly
+    # as paging.py computes it (crc32 of the parent-digest/key pair).
+    batch, per_batch, acc = 50_000, [], 0
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for i in range(batch):
+            acc ^= zlib.crc32(repr((acc, (i, i + 1, i + 2)))
+                              .encode('ascii'))
+        per_batch.append((time.perf_counter() - t0) / batch * 1e9)
+    ns_per_digest = min(per_batch)
+
+    # Per-token budget from a short saturated run of the real engine
+    # (the fingerprint accounting is always on — it ships in insert/
+    # evict — so this throughput already carries the cost it prices).
+    if on_tpu:
+        cfg = dataclasses.replace(LLAMA_CONFIGS['bench-600m'],
+                                  param_dtype=jnp.bfloat16)
+        n_slots, steps_per_call, buckets = 8, 16, (64, 256)
+        prompt_len, new_tokens, n_requests = 219, 96, 32
+    else:
+        cfg = dataclasses.replace(LLAMA_CONFIGS['tiny'], max_seq_len=128)
+        n_slots, steps_per_call, buckets = 4, 4, (8,)
+        prompt_len, new_tokens, n_requests = 8, 48, 12
+    model = Llama(cfg)
+    params = init_params(model, jax.random.PRNGKey(0))['params']
+    engine = DecodeEngine(
+        model, params,
+        EngineConfig(n_slots=n_slots, steps_per_call=steps_per_call,
+                     prefill_buckets=buckets))
+    engine.prewarm()
+    prompts = [rng.integers(0, cfg.vocab_size, prompt_len).tolist()
+               for _ in range(n_requests)]
+    w = engine.submit(prompts[0], 2)
+    while w.finished_at is None:
+        engine.step()
+    reqs = [engine.submit(p, new_tokens) for p in prompts]
+    t0 = time.perf_counter()
+    while any(r.finished_at is None for r in reqs):
+        engine.step_pipelined()
+    engine.drain()
+    wall = time.perf_counter() - t0
+    tok_s = sum(r.emitted for r in reqs) / wall
+    # One radix insert (+ at most one evict) per request; the digest is
+    # computed once per inserted node on the prompt path.
+    digests_per_token = 2.0 * n_requests / (n_requests * new_tokens)
+    overhead_pct = (digests_per_token * ns_per_digest * 1e-9) \
+        * tok_s * 100.0
+    return {
+        'us_per_ingest': round(us_per_ingest, 1),
+        'ingest_duty_pct': round(ingest_duty_pct, 4),
+        'ns_per_digest': round(ns_per_digest, 1),
+        'out_tok_per_s': round(tok_s, 1),
+        'overhead_pct': round(overhead_pct, 4),
+    }
+
+
 def bench_slo_ramp(plateau_ticks: int = 12) -> dict:
     """SLO-aware vs QPS-only autoscaling under a synthetic traffic ramp
     (virtual replicas, virtual time — hermetic and chip-free).
@@ -1009,6 +1134,7 @@ def bench_fleet(seed: int = None) -> dict:
             'horizon_s': result.horizon_s,
             'wall_s': result.wall_s,
         },
+        'alerts': result.alerts,
         'profile': {'sqlite': fleet_profile.top(result.profile),
                     'postgres': None},
     }
@@ -1080,6 +1206,11 @@ def main(argv=None) -> None:
     jax.clear_caches()
     gc.collect()
     serve['tracing'] = bench_trace_overhead(on_tpu)
+    # Telemetry-plane overhead: store ingest duty cycle + the one
+    # on-serving-path cost (the radix prefix-fingerprint digest).
+    jax.clear_caches()
+    gc.collect()
+    serve['obs'] = bench_obs_overhead(on_tpu)
     print(json.dumps({
         'metric': 'llama_train_mfu_single_chip',
         'value': train['mfu_pct'],

@@ -25,6 +25,13 @@ and `skytpu trace`'s decomposition keys on them — so every
 - the span name is legal (dotted lowercase, ``component.event``);
 - it has a ``SPAN_HELP`` entry in server/tracing.py.
 
+SLO alert rules (obs/alerts.py) are consumers on the far END of that
+contract: an ``AlertRule`` naming a family nobody registers would
+never fire and never error — the worst observability failure mode.  So
+every statically-visible ``AlertRule(...)`` construction's ``family=``
+/ ``ratio_family=`` keyword must resolve to a ``_HELP``-registered
+family.
+
 Names are resolved statically: string literals, module-level string
 constants, and ``metrics_lib.<CONST>`` attributes (parsed out of
 server/metrics.py — nothing is imported).  Dynamically-built names are
@@ -42,6 +49,9 @@ from skypilot_tpu.analysis.core import Finding, Module, Project, Rule
 
 _METRICS_MODULE = 'skypilot_tpu.server.metrics'
 _TRACING_MODULE = 'skypilot_tpu.server.tracing'
+_ALERTS_MODULE = 'skypilot_tpu.obs.alerts'
+# AlertRule keywords that must name a registered metric family.
+_ALERT_FAMILY_KWARGS = ('family', 'ratio_family')
 _NAME_RE = re.compile(r'^[a-zA-Z_:][a-zA-Z0-9_:]*$')
 # Span names: dotted lowercase, component.event.
 _SPAN_NAME_RE = re.compile(r'^[a-z][a-z0-9_]*(\.[a-z][a-z0-9_]*)+$')
@@ -151,6 +161,11 @@ class MetricNamingRule(Rule):
                         continue
                     findings.extend(self._check_span_name(
                         project, module, node, name, span_keys))
+                    continue
+                if self._is_alert_rule(node, module):
+                    findings.extend(self._check_alert_rule(
+                        project, module, node, consts, metrics_consts,
+                        help_keys))
         return findings
 
     def _registration_kind(self, call: ast.Call,
@@ -179,13 +194,52 @@ class MetricNamingRule(Rule):
         return last in _SPAN_FNS and \
             resolved == f'{_TRACING_MODULE}.{last}'
 
+    def _is_alert_rule(self, call: ast.Call, module: Module) -> bool:
+        dotted = cg._dotted(call.func)
+        if dotted is None:
+            return False
+        resolved = cg.resolve_alias(dotted, module)
+        return resolved == f'{_ALERTS_MODULE}.AlertRule'
+
+    def _check_alert_rule(self, project: Project, module: Module,
+                          call: ast.Call, consts: Dict[str, str],
+                          metrics_consts: Dict[str, str],
+                          help_keys) -> List[Finding]:
+        """Every statically-resolvable family reference in an AlertRule
+        must be a registered family — a rule watching an unregistered
+        name silently never fires (dynamically-built values are out of
+        static reach, same posture as registration names)."""
+        out: List[Finding] = []
+        if help_keys is None:
+            return out
+        for kw in call.keywords:
+            if kw.arg not in _ALERT_FAMILY_KWARGS:
+                continue
+            name = self._static_value(kw.value, module, consts,
+                                      metrics_consts)
+            if name is None or not name:
+                continue
+            if name not in help_keys:
+                out.append(project.finding(
+                    self, module, call,
+                    f'AlertRule {kw.arg}={name!r} references a family '
+                    f'with no _HELP entry in server/metrics.py — an '
+                    f'alert rule on an unregistered family can never '
+                    f'fire'))
+        return out
+
     def _static_name(self, call: ast.Call, module: Module,
                      consts: Dict[str, str],
                      metrics_consts: Dict[str, str],
                      arg_idx: int = 0) -> Optional[str]:
         if len(call.args) <= arg_idx:
             return None
-        arg = call.args[arg_idx]
+        return self._static_value(call.args[arg_idx], module, consts,
+                                  metrics_consts)
+
+    def _static_value(self, arg: ast.expr, module: Module,
+                      consts: Dict[str, str],
+                      metrics_consts: Dict[str, str]) -> Optional[str]:
         if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
             return arg.value
         if isinstance(arg, ast.Name):

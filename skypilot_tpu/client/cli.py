@@ -457,6 +457,107 @@ def profile_cmd(endpoint, duration_ms, out):
                    f'(open in ui.perfetto.dev)')
 
 
+@cli.command('alerts')
+@click.option('--endpoint', default=None,
+              envvar='SKYTPU_TRACE_ENDPOINT',
+              help='Service load-balancer base URL exposing /alerts '
+                   '(federated view of the controller\'s telemetry '
+                   'store).  Mutually exclusive with --db.')
+@click.option('--db', 'db_url', default=None,
+              help='Read the telemetry store directly — a sqlite path '
+                   'or postgres:// DSN (default: the local serve state '
+                   'database).  Used when no --endpoint is given.')
+@click.option('--service', default=None,
+              help='Filter to one service (default: all services in '
+                   'the store).')
+@click.option('--history', 'history_n', default=20, show_default=True,
+              help='Recent fire/clear transitions to show below the '
+                   'active set.')
+@click.option('--as-json', is_flag=True, help='Emit the raw document.')
+def alerts_cmd(endpoint, db_url, service, history_n, as_json):
+    """Show SLO burn-rate alerts: the active set + recent history.
+
+    The controller's telemetry plane evaluates declarative burn-rate
+    rules (TTFT/TPOT p95 vs the service's targets, shed rate, dark
+    scrapes, speculative-acceptance collapse, KV free-page exhaustion)
+    over multi-window burn rates and persists fire/clear transitions
+    in the state backend.  This reads them back, either through a load
+    balancer's /alerts endpoint or straight from the store.
+    """
+    import json as json_lib
+
+    if endpoint:
+        import urllib.error
+        import urllib.request
+        url = f'{endpoint.rstrip("/")}/alerts'
+        try:
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                doc = json_lib.load(resp)
+        except (urllib.error.URLError, OSError) as e:
+            raise click.ClickException(f'cannot reach {url}: {e}')
+        active, history = doc.get('active', []), doc.get('history', [])
+    else:
+        from skypilot_tpu.obs import store as obs_store
+        from skypilot_tpu.serve import serve_state
+        store = obs_store.TelemetryStore(db_url or
+                                         serve_state._db_path())
+        active = store.active_alerts(service)
+        history = store.alert_history(service, limit=history_n)
+        doc = {'active': active, 'history': history}
+    if as_json:
+        click.echo(json_lib.dumps(doc, indent=2, sort_keys=True))
+        return
+    if service:
+        active = [a for a in active if a['service'] == service]
+        history = [a for a in history if a['service'] == service]
+
+    def rows_of(items):
+        return [[a['service'], a['rule'], a['pool'] or '-', a['state'],
+                 f'{a["fired_at"]:.0f}',
+                 '-' if a.get('cleared_at') is None
+                 else f'{a["cleared_at"]:.0f}',
+                 f'{a["burn"]:.2f}'] for a in items]
+
+    click.echo(f'{len(active)} firing')
+    if active:
+        ux_utils.print_table(
+            ['SERVICE', 'RULE', 'POOL', 'STATE', 'FIRED_AT',
+             'CLEARED_AT', 'BURN'], rows_of(active))
+    if history:
+        click.echo('recent transitions:')
+        ux_utils.print_table(
+            ['SERVICE', 'RULE', 'POOL', 'STATE', 'FIRED_AT',
+             'CLEARED_AT', 'BURN'], rows_of(history[:history_n]))
+
+
+@cli.command('top')
+@click.option('--db', 'db_url', default=None,
+              help='Telemetry store to watch — a sqlite path or '
+                   'postgres:// DSN (default: the local serve state '
+                   'database).')
+@click.option('--service', default=None,
+              help='Service to watch (default: the first service with '
+                   'telemetry in the store).')
+@click.option('--interval', default=2.0, show_default=True,
+              help='Refresh period in seconds.')
+@click.option('--iterations', default=None, type=int,
+              help='Render this many frames then exit (default: run '
+                   'until Ctrl-C).')
+@click.option('--window', default=300.0, show_default=True,
+              help='Aggregation window in seconds for the per-pool '
+                   'table and sparklines.')
+def top_cmd(db_url, service, interval, iterations, window):
+    """Live fleet view: per-pool QPS, p95 TTFT/TPOT, MFU, prefix-hit
+    rate, free KV pages, and the active alert set, refreshed from the
+    controller's telemetry store."""
+    from skypilot_tpu.obs import store as obs_store
+    from skypilot_tpu.obs import top as obs_top
+    from skypilot_tpu.serve import serve_state
+    store = obs_store.TelemetryStore(db_url or serve_state._db_path())
+    raise SystemExit(obs_top.run(store, service, interval=interval,
+                                 iterations=iterations, window=window))
+
+
 @cli.command('perf')
 @click.option('--check', 'check_flag', is_flag=True,
               help='Exit non-zero if any regression check fails '

@@ -49,6 +49,8 @@ from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 from skypilot_tpu import task as task_lib
 from skypilot_tpu.fleetsim import profile as profile_lib
+from skypilot_tpu.obs import alerts as obs_alerts
+from skypilot_tpu.obs import store as obs_store
 from skypilot_tpu.fleetsim.scenario import (LBSever, LeaseholderKill,
                                             PreemptionStorm, Scenario)
 from skypilot_tpu.fleetsim.traffic import (Request, TrafficGenerator,
@@ -179,6 +181,11 @@ class FleetResult:
     profile: List[Dict[str, Any]] = dataclasses.field(
         default_factory=list)
     wall_s: float = 0.0
+    # The run's SLO alert timeline (obs/alerts.py over the ingested
+    # sim telemetry), fire-order, times in sim seconds — the canonical
+    # storm's fire/clear ticks are test-pinned from this list.
+    alerts: List[Dict[str, Any]] = dataclasses.field(
+        default_factory=list)
 
     def headline(self) -> str:
         """The README/bench claim, verbatim (test_readme_bench pins
@@ -298,6 +305,31 @@ class FleetSim:
             for i in range(config.n_lbs)
         ]
         self.dsn = serve_state._db_path()  # pylint: disable=protected-access
+        # Telemetry plane on the SAME code path production runs: the
+        # decision tick ingests the service exposition at sim time and
+        # the alert engine burns over it, with the burn windows scaled
+        # to sim ticks (5m/1h + 30m/6h compressed the same way the
+        # diurnal "day" is).  leader_check is skipped per-scrape — the
+        # tick itself is already lease-gated.
+        self._obs = obs_store.TelemetryStore(
+            self.dsn, resolution=config.tick_s,
+            retention=max(config.horizon_s,
+                          30.0 * config.tick_s) + config.tick_s)
+        # clear_ratio 0.98 for the latency rules (not production's
+        # 0.9): the sim's healthy TPOT (20 ms) interpolates inside the
+        # 10–25 ms exposition bucket to a p95 of 24.25 ms = burn 0.97
+        # against the 25 ms target, so a 0.9 clear bar could never be
+        # reached — bucket quantization floors the burn a rule can see.
+        rules = tuple(
+            dataclasses.replace(r, clear_ratio=0.98)
+            if r.kind == 'latency_burn' else r
+            for r in obs_alerts.default_rules(config.target_ttft_ms,
+                                              config.target_tpot_ms))
+        self._alert_engine = obs_alerts.AlertEngine(
+            self._obs, config.service_name, rules,
+            windows=obs_alerts.BurnWindows(
+                fast=(5.0 * config.tick_s, 15.0 * config.tick_s),
+                slow=(10.0 * config.tick_s, 30.0 * config.tick_s)))
         self._lease_name = f'fleetsim-controller-{config.service_name}'
         self._virt = f'{config.service_name}-ctrl-a:0:virtual0'
         self._virtual_holder_alive = True
@@ -657,6 +689,15 @@ class FleetSim:
         if not can_decide:
             self._lease_frozen_s += self.cfg.tick_s
             return
+        with _timed('obs.ingest'):
+            # leader_check=False: this tick IS the singleton decision
+            # path — the freeze window above therefore shows up as a
+            # telemetry gap, which is exactly what dark_scrape alerts
+            # on after takeover.
+            self._obs.ingest(self.cfg.service_name,
+                             self.service.exposition(),
+                             now=_EPOCH0 + t, leader_check=False)
+            self._alert_engine.evaluate(_EPOCH0 + t)
         with _timed('autoscaler.evaluate'):
             decision = self.autoscaler.evaluate_pools(
                 self.service.exposition(), total_requests, live_p,
@@ -766,6 +807,20 @@ class FleetSim:
                 if ok is not None:
                     recovery = ok['t'] - self._storm_t
         seen = self.totals['hit_tokens'] + self.totals['miss_tokens']
+        alerts: List[Dict[str, Any]] = []
+        for row in self._obs.alert_history(self.cfg.service_name,
+                                           limit=100):
+            alerts.append({
+                'rule': row['rule'],
+                'pool': row['pool'],
+                'state': row['state'],
+                'fired_at_s': round(row['fired_at'] - _EPOCH0, 3),
+                'cleared_at_s': (round(row['cleared_at'] - _EPOCH0, 3)
+                                 if row['cleared_at'] is not None
+                                 else None),
+                'burn': row['burn'],
+            })
+        alerts.sort(key=lambda a: (a['fired_at_s'], a['rule']))
         return FleetResult(
             sustained_qps_at_slo=round(sustained, 1),
             peak_replicas=peak,
@@ -786,6 +841,7 @@ class FleetSim:
                   else slo_sim.FLEET_SEED),
             horizon_s=self.cfg.horizon_s,
             history=history,
+            alerts=alerts,
         )
 
 

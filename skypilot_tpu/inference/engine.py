@@ -2416,13 +2416,21 @@ class DecodeEngine:
                   len(self._ready_q) + len(self._hit_q) +
                   self._adopt_q.qsize() + len(self._adopt_ready),
                   self._queued_tokens,
-                  self._pool_alloc.free_pages if self._paged else -1)
+                  self._pool_alloc.free_pages if self._paged else -1,
+                  self._radix.fingerprint
+                  if self._radix is not None else None)
         if sample == self._last_gauges:
             return
         self._last_gauges = sample
         if self._paged:
             metrics_lib.set_gauge('skytpu_engine_kv_free_pages',
                                   float(sample[3]))
+        if sample[4] is not None:
+            # Prefix-set identity of this replica's radix cache: the
+            # controller's scrape ingests it per replica, so affinity
+            # routing (ROADMAP item 2) can group replicas by content.
+            metrics_lib.set_gauge('skytpu_engine_prefix_fingerprint',
+                                  float(sample[4]))
         metrics_lib.set_gauge('skytpu_engine_active_slots',
                               float(n_active))
         metrics_lib.set_gauge('skytpu_engine_batch_occupancy_ratio',

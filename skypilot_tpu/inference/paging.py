@@ -33,6 +33,7 @@ branch here would couple host bookkeeping to device layout.
 """
 from __future__ import annotations
 
+import zlib
 from typing import Dict, List, Optional, Tuple
 
 # Page 0 is reserved as the TRASH page: every page-table entry beyond a
@@ -112,7 +113,8 @@ class PagePool:
 
 
 class _Node:
-    __slots__ = ('key', 'page', 'children', 'parent', 'last_hit')
+    __slots__ = ('key', 'page', 'children', 'parent', 'last_hit',
+                 'digest')
 
     def __init__(self, key: Optional[Tuple[int, ...]], page: int,
                  parent: Optional['_Node']) -> None:
@@ -121,6 +123,16 @@ class _Node:
         self.children: Dict[Tuple[int, ...], '_Node'] = {}
         self.parent = parent
         self.last_hit = 0
+        # Path digest: folds the parent's digest with this node's
+        # token key, so the digest identifies the full PREFIX the node
+        # spells, not just its last page.  Content-only (no pool page
+        # ids, no clocks): two caches holding the same prefixes agree
+        # byte-for-byte across processes.
+        if parent is None:
+            self.digest = 0
+        else:
+            self.digest = zlib.crc32(
+                repr((parent.digest, key)).encode('ascii'))
 
 
 class RadixCache:
@@ -140,6 +152,12 @@ class RadixCache:
         self._root = _Node(None, TRASH_PAGE, None)
         self._clock = 0
         self.nodes = 0
+        # Rolling fingerprint of the RESIDENT prefix set: XOR of every
+        # live node's path digest, updated O(1) on insert/evict.  Equal
+        # caches expose equal fingerprints (XOR is order-free), so the
+        # federated `skytpu_engine_prefix_fingerprint` gauge tells the
+        # router which replicas hold the same hot prefixes.
+        self.fingerprint = 0
 
     def _keys(self, tokens: List[int], n_pages: int):
         ps = self._pool.page_size
@@ -181,6 +199,7 @@ class RadixCache:
                 node.children[key] = child
                 self._pool.ref([pages[i]])
                 self.nodes += 1
+                self.fingerprint ^= child.digest
                 adopted += 1
             child.last_hit = self._clock
             node = child
@@ -211,5 +230,6 @@ class RadixCache:
             victim = min(leaves, key=lambda nd: (nd.last_hit, nd.page))
             del victim.parent.children[victim.key]
             self.nodes -= 1
+            self.fingerprint ^= victim.digest
             freed += self._pool.release([victim.page])
         return freed

@@ -18,6 +18,8 @@ from typing import Dict, Optional
 from skypilot_tpu import catalog
 from skypilot_tpu import sky_logging
 from skypilot_tpu import task as task_lib
+from skypilot_tpu.obs import alerts as obs_alerts
+from skypilot_tpu.obs import store as obs_store
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve.autoscalers import Autoscaler
 from skypilot_tpu.serve.load_balancer import LoadBalancer
@@ -67,6 +69,10 @@ class ServiceController:
                 self.spec.max_queue_tokens_per_replica))
         self.autoscaler = Autoscaler.make(self.spec, _tick_interval(),
                                           _qps_window())
+        # Telemetry plane (lazy: built on the first tick so a
+        # SKYTPU_OBS_RESOLUTION_S=0 opt-out costs nothing).
+        self._obs_store: Optional[obs_store.TelemetryStore] = None
+        self._obs_engine: Optional[obs_alerts.AlertEngine] = None
 
     def run(self) -> None:
         try:
@@ -154,6 +160,7 @@ class ServiceController:
             # dashboards read.
             exposition = (self._scrape_lb_metrics()
                           if self.autoscaler.wants_lb_scrape else None)
+            self._obs_tick(exposition, now)
             if self.autoscaler.is_pool_autoscaler:
                 # Disaggregated pools: one scrape, two independent
                 # decisions — TTFT sizes prefill, TPOT sizes decode.
@@ -219,6 +226,38 @@ class ServiceController:
             logger.debug(f'Service {self.service_name!r}: LB metrics '
                          f'scrape failed: {e}')
         return None
+
+    def _obs_tick(self, exposition: Optional[str], now: float) -> None:
+        """Feed this tick's federated scrape into the telemetry store
+        and run the SLO alert rules.  Reuses the autoscaler's scrape
+        when one happened; QPS-policy services get their own (the
+        telemetry plane sees every service, not just SLO-scaled ones).
+        Telemetry must never break the decision loop, and in HA control
+        planes only the obs-ingest singleton-lease holder writes (the
+        store enforces that)."""
+        try:
+            if self._obs_store is None:
+                if obs_store.resolution_s() <= 0:
+                    return  # opted out; re-checked next tick (cheap)
+                self._obs_store = obs_store.TelemetryStore(
+                    serve_state._db_path())  # pylint: disable=protected-access
+                self._obs_engine = obs_alerts.AlertEngine(
+                    self._obs_store, self.service_name,
+                    obs_alerts.default_rules(
+                        self.spec.target_ttft_ms or 1000.0,
+                        self.spec.target_tpot_ms or 100.0))
+            if exposition is None:
+                exposition = self._scrape_lb_metrics()
+            if exposition is None:
+                return
+            roles = {str(rid): role or ''
+                     for rid, _, role in self.manager.ready_replicas()}
+            if self._obs_store.ingest(self.service_name, exposition,
+                                      now=now, roles=roles):
+                self._obs_engine.evaluate(now)
+        except Exception:  # pylint: disable=broad-except
+            logger.exception(f'Service {self.service_name!r}: telemetry '
+                             f'ingest failed (decision loop continues)')
 
     def _slo_note(self) -> str:
         ttft = getattr(self.autoscaler, 'last_p95_ttft_ms', None)

@@ -221,6 +221,7 @@ class LoadBalancer:
             self._roles = {}
         current = frozenset(urls)
         if current != self._last_ready_set:
+            joined = current - self._last_ready_set
             self._last_ready_set = current
             now = time.monotonic()
             for u in current:
@@ -228,6 +229,13 @@ class LoadBalancer:
                 # is its JOIN time — a fresh replica must not inherit
                 # the LB's whole uptime as its "dark" age.
                 self._ready_since.setdefault(u, now)
+            for u in joined:
+                # Readmission re-baseline: a replica that flapped
+                # ready -> notready -> ready comes back "dark since
+                # rejoin" — its previous incarnation's scrape success
+                # must not vouch for (or age-penalize) the new one.
+                self._ready_since[u] = now
+                self._scrape_ok_at.pop(u, None)
             for stale in [u for u in self._backlog if u not in current]:
                 del self._backlog[stale]
             for stale in [u for u in self._ready_since
@@ -590,7 +598,12 @@ class LoadBalancer:
                             total=_FEDERATE_TIMEOUT_SECONDS)) as resp:
                     if resp.status == 200:
                         text = await resp.text()
-                        self._scrape_ok_at[url] = time.monotonic()
+                        # Guard against the write-after-prune replant:
+                        # a scrape that was in flight when its replica
+                        # left the ready set must not resurrect the
+                        # departed URL's age baseline.
+                        if url in self._last_ready_set:
+                            self._scrape_ok_at[url] = time.monotonic()
                         self._note_backlog_from_exposition(url, text)
                         return (str(rid), text)
             except (aiohttp.ClientError, asyncio.TimeoutError,
@@ -733,6 +746,26 @@ class LoadBalancer:
                 status=404)
         return web.json_response(payload)
 
+    async def _alerts(self, _request: web.Request) -> web.Response:
+        """Federated SLO alert view: the durable obs_alerts rows the
+        controller's alert engine maintains, served at the same
+        endpoint the service is reached on — `skytpu alerts` needs
+        only the LB URL, exactly like /metrics and /debug/requests."""
+        from skypilot_tpu.obs import store as obs_store
+        from skypilot_tpu.serve import serve_state
+        try:
+            store = obs_store.TelemetryStore(
+                serve_state._db_path())  # pylint: disable=protected-access
+            doc = {
+                'service': self.service_name,
+                'active': store.active_alerts(self.service_name),
+                'history': store.alert_history(self.service_name,
+                                               limit=50),
+            }
+        except Exception as e:  # pylint: disable=broad-except
+            return web.json_response({'error': repr(e)}, status=500)
+        return web.json_response(doc)
+
     # ----- lifecycle ----------------------------------------------------------
     def start(self) -> None:
         assert self._thread is None, 'LB already started'
@@ -759,6 +792,7 @@ class LoadBalancer:
             app.router.add_get('/debug/requests/{request_id}',
                                self._debug_request)
             app.router.add_get('/debug/profile', self._debug_profile)
+            app.router.add_get('/alerts', self._alerts)
             app.router.add_route('*', '/{tail:.*}', self._handle)
             runner = web.AppRunner(app)
             await runner.setup()

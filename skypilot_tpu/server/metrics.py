@@ -218,6 +218,26 @@ _HELP = {
         'Cacheable prefix tokens by outcome (hit = served from a '
         'replica\'s radix cache, miss = prefilled) — the emergent '
         'prefix-cache hit rate of the simulated session traffic',
+    # ----- fleet telemetry plane (obs/) ------------------------------------
+    'skytpu_engine_prefix_fingerprint':
+        'Rolling-hash fingerprint of the radix cache\'s resident '
+        'prefixes (XOR of per-node page-key digests, as an integer '
+        'gauge) — two replicas holding the same hot prefixes expose '
+        'the same value, the affinity-routing signal for ROADMAP '
+        'item 2',
+    'skytpu_obs_ingest_total':
+        'Telemetry-store ingests performed by this process (one per '
+        'downsampled federated scrape), by service — the durable twin '
+        'is one heartbeat row per interval, whose gaps the '
+        'dark_scrape alert rule measures',
+    'skytpu_obs_ingest_seconds':
+        'Wall time to downsample one federated scrape into the '
+        'telemetry store (parse + delta extraction + one batched '
+        'transaction), by service — the bench_obs_overhead '
+        'per-scrape cost lives in this histogram',
+    'skytpu_obs_alerts_total':
+        'SLO alert transitions by rule and transition (fire / clear) '
+        '— the counter twin of the durable obs_alerts rows',
 }
 
 # Fixed bucket upper bounds per histogram family (seconds unless the
@@ -255,6 +275,12 @@ _BUCKETS: Dict[str, Tuple[float, ...]] = {
     'skytpu_fleetsim_control_seconds':
         (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
          0.5, 1.0, 2.5, 5.0, 10.0, 30.0),
+    # One telemetry-store ingest = parse + deltas + one transaction:
+    # microseconds-to-milliseconds on sqlite, a network round-trip on
+    # Postgres — same shape as db ops.
+    'skytpu_obs_ingest_seconds':
+        (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+         0.5, 1.0, 2.5, 5.0),
 }
 
 # Family names referenced OUTSIDE the exporting process (the LB's

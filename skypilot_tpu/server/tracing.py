@@ -127,6 +127,15 @@ SPAN_HELP = {
     'perf.profile_capture':
         'On-demand jax.profiler window served by /debug/profile '
         '(attrs: Perfetto artifact path and size)',
+    # ----- fleet telemetry plane (obs/) ------------------------------------
+    'alert.fire':
+        'SLO burn-rate alert began firing (rid "alert-engine"): attrs '
+        'carry the service, rule, attributed pool, and the fast '
+        'short-window burn at the transition — the durable record is '
+        'the obs_alerts row',
+    'alert.clear':
+        'SLO burn-rate alert cleared with hysteresis (fast '
+        'short-window burn back under the rule\'s clear_ratio)',
     # ----- managed jobs (postmortem events) --------------------------------
     'jobs.preemption':
         'Managed job cluster lost to preemption (cloud says not-UP)',

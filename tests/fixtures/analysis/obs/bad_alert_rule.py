@@ -1,0 +1,29 @@
+"""Known-bad: SLO alert rules referencing unregistered metric
+families (metric-naming rule, alert-rule half).  A rule watching a
+family nobody exports silently never fires — the analyzer must catch
+the reference statically."""
+from skypilot_tpu.obs import alerts as obs_alerts
+from skypilot_tpu.obs.alerts import AlertRule
+from skypilot_tpu.server import metrics as metrics_lib
+
+ROGUE_FAMILY = 'skytpu_engine_rogue_latency_seconds'
+
+
+def rules():
+    return (
+        # BAD: literal family with no _HELP entry.
+        AlertRule(name='rogue_latency', kind='latency_burn',
+                  family='skytpu_obs_rogue_seconds', target=25.0),
+        # BAD: module-constant family with no _HELP entry, via the
+        # aliased module path.
+        obs_alerts.AlertRule(name='rogue_const', kind='latency_burn',
+                             family=ROGUE_FAMILY, target=10.0),
+        # BAD: registered numerator but unregistered denominator.
+        AlertRule(name='rogue_ratio', kind='ratio',
+                  family='skytpu_lb_shed_total',
+                  ratio_family='skytpu_lb_rogue_total', target=0.05),
+        # OK: registered families resolved through every supported
+        # form (metrics_lib attribute and literal).
+        AlertRule(name='fine', kind='latency_burn',
+                  family=metrics_lib.ENGINE_TPOT_FAMILY, target=25.0),
+    )

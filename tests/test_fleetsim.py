@@ -283,6 +283,44 @@ def test_smoke_fleet_profile_ranks_hot_paths(smoke_run):
     assert 'control-plane path' in report and top3[0] in report
 
 
+def test_smoke_fleet_alert_timeline(smoke_run):
+    """The telemetry plane watched the same run through the real
+    store + burn-rate engine: the decode-pool TPOT burn fires no later
+    than the provision delay after the 50% storm and clears once the
+    replacement capacity drains the backlog, and the lease freeze
+    surfaces as a dark-scrape (missing-ingest) alert that clears after
+    the takeover resumes ingestion."""
+    result, _, _ = smoke_run
+    cfg = sim_lib.fleet_config(smoke=True)
+    storm = next(e for e in cfg.scenario.events
+                 if isinstance(e, PreemptionStorm))
+    by_rule = {a['rule']: a for a in result.alerts}
+
+    tpot = by_rule['tpot_slo_burn']
+    assert tpot['pool'] == 'decode'
+    assert tpot['fired_at_s'] <= storm.at_s + cfg.provision_delay_s
+    assert tpot['state'] == 'cleared'
+    assert tpot['cleared_at_s'] > storm.at_s + cfg.provision_delay_s
+    assert tpot['burn'] > 1.0
+
+    dark = by_rule['dark_scrape']
+    kill = next(e for e in cfg.scenario.events
+                if isinstance(e, LeaseholderKill))
+    # Ingest stops with the killed leaseholder and the gap crosses the
+    # alert threshold right as the takeover tick resumes evaluation.
+    assert dark['fired_at_s'] == pytest.approx(
+        kill.at_s + result.lease_frozen_s)
+    assert dark['state'] == 'cleared'
+    assert dark['cleared_at_s'] > dark['fired_at_s']
+
+    # The exact timeline is pinned: the run is deterministic, so any
+    # drift here is a behaviour change in the control stack or engine.
+    assert [(a['rule'], a['fired_at_s'], a['cleared_at_s'])
+            for a in result.alerts] == [
+                ('tpot_slo_burn', 18.0, 34.0),
+                ('dark_scrape', 24.0, 27.0)]
+
+
 def test_virtual_manager_overrides_only_the_cloud_boundary():
     """The override surface IS the proof that everything else is
     production code: exactly the two cloud-boundary methods (plus

@@ -235,3 +235,118 @@ def test_federated_window_per_series_reset_is_local():
     assert w.sample_count(now=10.0) == 20.0
     w.record({a: {math.inf: 20.0}, b: {math.inf: 8.0}}, now=20.0)
     assert w.sample_count(now=20.0) == 26.0   # a:20 + b:6 post-reset
+
+
+# ----- telemetry-store downsampler (obs/store.py) -----------------------------
+# The store's scrape->delta stage reuses this module's parsing and the
+# same reset posture; the property tests live here with the rest of the
+# counter math.
+def _counter_text(per_replica):
+    return ''.join(
+        f'skytpu_lb_requests_total{{replica="{r}"}} {v}\n'
+        for r, v in sorted(per_replica.items()))
+
+
+def test_downsampler_counter_reset_never_negative():
+    from skypilot_tpu.obs.store import Downsampler
+    d = Downsampler()
+    key = ('skytpu_lb_requests_total', '', '')
+
+    def step(v, now):
+        out = d.observe(
+            metrics_math.parse_samples(_counter_text({'0': v})), now)
+        return out['counters'].get(key, 0.0)
+
+    assert step(100.0, 0.0) == 0.0            # first sight: baseline
+    assert step(110.0, 10.0) == 10.0
+    # Replica restart: cumulative goes backward — contribute nothing,
+    # re-baseline, then resume counting from the new origin.
+    assert step(3.0, 20.0) == 0.0
+    assert step(9.0, 30.0) == 6.0
+
+
+def test_downsampler_churn_property_no_negative_no_overcount():
+    """Property: over random per-replica counter walks with restarts
+    (value drops to a small number) and churn (replicas leave/rejoin),
+    every emitted delta is >= 0 and the emitted total never exceeds the
+    true number of increments (reset-aware extraction may UNDER-count
+    by one interval of partial vision, never over-count)."""
+    from skypilot_tpu.obs.store import Downsampler
+    rng = random.Random(99)
+    for trial in range(20):
+        d = Downsampler(forget_after_s=30.0)
+        cum = {}                     # replica -> exported cumulative
+        true_increments = 0.0
+        emitted = 0.0
+        alive = {'0', '1', '2'}
+        for tick in range(40):
+            for r in list(alive):
+                inc = rng.randrange(0, 20)
+                if rng.random() < 0.1:           # restart: registry zeroed
+                    cum[r] = 0.0
+                else:
+                    cum[r] = cum.get(r, 0.0) + inc
+                    true_increments += inc
+            if rng.random() < 0.15 and len(alive) > 1:
+                gone = rng.choice(sorted(alive))
+                alive.discard(gone)
+                cum.pop(gone, None)
+            elif rng.random() < 0.15:
+                alive.add(rng.choice(('0', '1', '2', '3')))
+            out = d.observe(
+                metrics_math.parse_samples(_counter_text(
+                    {r: cum.get(r, 0.0) for r in alive})),
+                float(tick))
+            for delta in out['counters'].values():
+                assert delta >= 0.0, (trial, tick, delta)
+                emitted += delta
+        assert emitted <= true_increments + 1e-6, (trial, emitted,
+                                                   true_increments)
+
+
+def test_downsampler_histogram_deltas_conserve_without_resets():
+    """With no resets, the summed per-scrape histogram deltas equal the
+    total observations after the baseline scrape — downsampling loses
+    resolution, not events."""
+    from skypilot_tpu.obs.store import Downsampler
+    d = Downsampler()
+    fam = 'skytpu_engine_ttft_seconds'
+
+    def text(c01, cinf):
+        return (f'{fam}_bucket{{le="0.1",replica="0"}} {c01}\n'
+                f'{fam}_bucket{{le="+Inf",replica="0"}} {cinf}\n')
+
+    assert d.observe(metrics_math.parse_samples(text(2, 3)),
+                     0.0)['hist'] == {}        # baseline
+    total = 0.0
+    c01, cinf = 2.0, 3.0
+    rng = random.Random(5)
+    for tick in range(1, 20):
+        fast, slow = rng.randrange(0, 9), rng.randrange(0, 4)
+        c01 += fast
+        cinf += fast + slow
+        out = d.observe(metrics_math.parse_samples(text(c01, cinf)),
+                        float(tick))
+        total += out['hist'].get((fam, '', '+Inf'), 0.0)
+    assert total == pytest.approx(cinf - 3.0)
+
+
+def test_downsampler_pool_attribution_and_gauges():
+    from skypilot_tpu.obs.store import Downsampler
+    d = Downsampler()
+    fam = 'skytpu_engine_ttft_seconds'
+
+    def text(a, b):
+        return (f'{fam}_bucket{{le="+Inf",replica="0"}} {a}\n'
+                f'{fam}_bucket{{le="+Inf",replica="1"}} {b}\n'
+                'skytpu_engine_kv_free_pages{replica="1"} 77\n')
+
+    roles = {'0': 'prefill', '1': 'decode'}
+    d.observe(metrics_math.parse_samples(text(10, 20)), 0.0, roles)
+    out = d.observe(metrics_math.parse_samples(text(13, 24)), 10.0,
+                    roles)
+    assert out['hist'] == {(fam, 'prefill', '+Inf'): 3.0,
+                           (fam, 'decode', '+Inf'): 4.0}
+    # Gauges pass through (latest value, replica-scoped), pool-tagged.
+    assert out['gauges'] == {
+        ('skytpu_engine_kv_free_pages', 'decode', '1'): 77.0}

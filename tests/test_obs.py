@@ -1,0 +1,400 @@
+"""Fleet telemetry plane (skypilot_tpu/obs): store conformance on both
+state backends (retention, singleton-only ingest, reset-safe rates),
+the multi-window burn-rate alert engine's state machine, the live
+`skytpu top` view, and the LB /alerts federation endpoint."""
+import json
+import math
+import time
+import urllib.request
+
+import pytest
+
+from pg_utils import make_backend_url_fixture
+from skypilot_tpu.obs import alerts as obs_alerts
+from skypilot_tpu.obs import store as obs_store
+from skypilot_tpu.obs import top as obs_top
+from skypilot_tpu.server import metrics as metrics_lib
+from skypilot_tpu.server import tracing
+from skypilot_tpu.state import leases
+from skypilot_tpu.utils import db_utils
+
+backend_url = make_backend_url_fixture('obs')
+
+TPOT = metrics_lib.ENGINE_TPOT_FAMILY
+T0 = 1_000_000.0
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    metrics_lib.reset_for_tests()
+    tracing.reset_for_tests()
+    yield
+    metrics_lib.reset_for_tests()
+    tracing.reset_for_tests()
+
+
+@pytest.fixture
+def dsn(backend_url, tmp_path):
+    return backend_url or str(tmp_path / 'obs.db')
+
+
+def _expo(tpot_fast=0, tpot_slow=0, requests=0, shed=0, free_pages=None,
+          replica='0'):
+    """A minimal replica exposition: cumulative TPOT histogram (fast
+    bucket 0.01s, slow beyond 0.1s), traffic counters, and a gauge."""
+    inf = tpot_fast + tpot_slow
+    lines = [
+        f'{TPOT}_bucket{{le="0.01",replica="{replica}"}} {tpot_fast}',
+        f'{TPOT}_bucket{{le="0.1",replica="{replica}"}} {tpot_fast}',
+        f'{TPOT}_bucket{{le="+Inf",replica="{replica}"}} {inf}',
+        f'skytpu_lb_requests_total {requests}',
+        f'skytpu_lb_shed_total {shed}',
+    ]
+    if free_pages is not None:
+        lines.append(f'skytpu_engine_kv_free_pages'
+                     f'{{replica="{replica}"}} {free_pages}')
+    return '\n'.join(lines) + '\n'
+
+
+# ---------------------------------------------------------------------------
+# Store conformance (sqlite + Postgres via the backend fixture)
+# ---------------------------------------------------------------------------
+def test_store_ingest_rates_and_quantiles(dsn):
+    store = obs_store.TelemetryStore(dsn, resolution=1.0)
+    store.ingest('svc', _expo(tpot_fast=100, requests=1000),
+                 now=T0, leader_check=False)
+    store.ingest('svc', _expo(tpot_fast=140, tpot_slow=10,
+                              requests=1300, shed=26, free_pages=64.0),
+                 now=T0 + 1, leader_check=False)
+    # Counters land as per-interval DELTAS, not lifetime totals.
+    assert store.counter_sum('svc', 'skytpu_lb_requests_total',
+                             T0, T0 + 2) == pytest.approx(300.0)
+    assert store.counter_sum('svc', 'skytpu_lb_shed_total',
+                             T0, T0 + 2) == pytest.approx(26.0)
+    # Histogram deltas reconstruct a windowed p95: 40 fast + 10 slow
+    # observations -> the p95 rank lands beyond 0.1 (clamped there).
+    q = store.quantile('svc', TPOT, T0, T0 + 2, 0.95)
+    assert q == pytest.approx(0.1)
+    assert store.gauge_latest('svc', 'skytpu_engine_kv_free_pages') \
+        == {'0': pytest.approx(64.0)}
+    assert store.services() == ['svc']
+
+
+def test_store_no_negative_rates_across_churn(dsn):
+    """Replica restarts (cumulative counters go backward) and churn
+    must never produce a negative windowed rate."""
+    store = obs_store.TelemetryStore(dsn, resolution=1.0)
+    store.ingest('svc', _expo(requests=10_000), now=T0,
+                 leader_check=False)
+    # Restart: the registry zeroes; then a new replica label appears
+    # carrying its own lifetime counts.
+    store.ingest('svc', _expo(requests=7), now=T0 + 1,
+                 leader_check=False)
+    store.ingest('svc', _expo(requests=12, tpot_fast=50, replica='9'),
+                 now=T0 + 2, leader_check=False)
+    total = store.counter_sum('svc', 'skytpu_lb_requests_total',
+                              T0, T0 + 3)
+    assert total == pytest.approx(5.0)   # only the post-reset growth
+    assert total >= 0.0
+    for _, v in store.series('svc', 'skytpu_lb_requests_total',
+                             T0, T0 + 3):
+        assert v >= 0.0
+
+
+def test_store_retention_enforced(dsn):
+    store = obs_store.TelemetryStore(dsn, resolution=1.0,
+                                     retention=5.0)
+    for i in range(20):
+        store.ingest('svc', _expo(requests=10 * i), now=T0 + i,
+                     leader_check=False)
+    rows = store.series('svc', obs_store.INGEST_FAMILY, T0 - 1,
+                        T0 + 60)
+    assert rows, 'ingest heartbeat rows missing'
+    oldest = min(t for t, _ in rows)
+    # Everything older than the retention horizon is gone.
+    assert oldest >= T0 + 19 - 5.0 - store.resolution
+    assert store.first_t('svc', obs_store.INGEST_FAMILY) == oldest
+
+
+def test_store_singleton_only_ingest(dsn, monkeypatch):
+    """With lease mode on, only the obs-ingest singleton holder may
+    write: a second control-plane replica observing the same backend
+    ingests NOTHING while a live holder exists, and takes over once
+    the holder's heartbeat goes stale."""
+    monkeypatch.setenv('SKYTPU_DB_LEASES', '1')
+    store = obs_store.TelemetryStore(dsn, resolution=1.0)
+    other = 'otherhost:1:feedface'
+    leases._ensure(dsn)  # pylint: disable=protected-access
+
+    def plant_heartbeat(alive):
+        now = time.time()
+        if leases._is_pg(dsn):  # pylint: disable=protected-access
+            offset = '0' if alive else '9999'
+            db_utils.execute(
+                dsn,
+                f'INSERT INTO server_instances (instance_id, host, '
+                f'pid, started_at, last_heartbeat) VALUES '
+                f'(?,?,?,?,{leases._PG_NOW} - {offset}) '  # pylint: disable=protected-access
+                f'ON CONFLICT(instance_id) DO UPDATE SET '
+                f'last_heartbeat={leases._PG_NOW} - {offset}',  # pylint: disable=protected-access
+                (other, 'otherhost', 1, now))
+        else:
+            hb = now if alive else now - 9999.0
+            db_utils.execute(
+                dsn,
+                'INSERT INTO server_instances (instance_id, host, '
+                'pid, started_at, last_heartbeat) VALUES (?,?,?,?,?) '
+                'ON CONFLICT(instance_id) DO UPDATE SET '
+                'last_heartbeat=excluded.last_heartbeat',
+                (other, 'otherhost', 1, now, hb))
+
+    plant_heartbeat(alive=True)
+    db_utils.execute(
+        dsn, 'INSERT INTO singleton_leases (name, instance_id, '
+        'acquired_at) VALUES (?,?,?)',
+        (obs_store.INGEST_LEASE, other, time.time()))
+    assert store.ingest('svc', _expo(requests=5), now=T0) is False
+    assert store.series('svc', obs_store.INGEST_FAMILY, T0 - 1,
+                        T0 + 9) == []
+    # The holder dies: its heartbeat ages out, the CAS takeover runs,
+    # and ingest resumes under the new owner.
+    plant_heartbeat(alive=False)
+    assert store.ingest('svc', _expo(requests=5), now=T0 + 1) is True
+    assert store.series('svc', obs_store.INGEST_FAMILY, T0 - 1,
+                        T0 + 9) != []
+
+
+def test_store_alert_rows_roundtrip(dsn):
+    store = obs_store.TelemetryStore(dsn, resolution=1.0)
+    store.fire_alert('svc', 'tpot_slo_burn', 'decode', T0, 2.5,
+                     json.dumps({'5s': 2.5}))
+    (active,) = store.active_alerts('svc')
+    assert (active['rule'], active['pool'], active['state']) == \
+        ('tpot_slo_burn', 'decode', 'firing')
+    store.clear_alert('svc', 'tpot_slo_burn', T0 + 9)
+    assert store.active_alerts('svc') == []
+    (row,) = store.alert_history('svc')
+    assert row['state'] == 'cleared'
+    assert row['cleared_at'] == pytest.approx(T0 + 9)
+
+
+# ---------------------------------------------------------------------------
+# Alert engine state machine (sqlite; backend-independent logic)
+# ---------------------------------------------------------------------------
+WINDOWS = obs_alerts.BurnWindows(fast=(2.0, 4.0), slow=(4.0, 8.0))
+
+
+def _engine(store, rules):
+    return obs_alerts.AlertEngine(store, 'svc', rules, windows=WINDOWS)
+
+
+def _tpot_rule(**kw):
+    base = dict(name='tpot', kind='latency_burn', family=TPOT,
+                pool='decode', target=25.0)
+    base.update(kw)
+    return obs_alerts.AlertRule(**base)
+
+
+def test_alert_engine_fires_and_clears_once(tmp_path):
+    """A sustained breach fires exactly one alert; recovery clears it
+    exactly once — no flapping on the way down (the clear requires
+    every window pair below clear_ratio, symmetric with fire)."""
+    store = obs_store.TelemetryStore(str(tmp_path / 'a.db'),
+                                     resolution=1.0)
+    eng = _engine(store, [_tpot_rule()])
+    transitions = []
+    fast = slow = 0
+    for tick in range(30):
+        fast += 100
+        if 10 <= tick < 16:
+            slow += 40                   # breach: 40% of samples slow
+        store.ingest('svc', _expo(tpot_fast=fast, tpot_slow=slow),
+                     now=T0 + tick, leader_check=False)
+        transitions += eng.evaluate(T0 + tick)
+    kinds = [(t['transition'], t['t'] - T0) for t in transitions]
+    assert len(kinds) == 2, kinds
+    (fire, fire_t), (clear, clear_t) = kinds
+    assert fire == 'fire' and clear == 'clear'
+    assert 10 <= fire_t < 16                 # during the breach
+    assert clear_t > 16                      # after recovery
+    assert transitions[0]['burn'] > 1.0
+    # Durable rows + flight-recorder instants carry the same story.
+    (row,) = store.alert_history('svc')
+    assert (row['state'], row['fired_at'] - T0,
+            row['cleared_at'] - T0) == ('cleared', fire_t, clear_t)
+    names = [e['name'] for e in
+             tracing.events_for(obs_alerts.ALERT_RID)]
+    assert names.count('alert.fire') == 1
+    assert names.count('alert.clear') == 1
+
+
+def test_alert_engine_blip_does_not_fire(tmp_path):
+    """Multi-window discipline: a single-interval latency spike trips
+    the short window but not the long one — no alert."""
+    store = obs_store.TelemetryStore(str(tmp_path / 'b.db'),
+                                     resolution=1.0)
+    eng = _engine(store, [_tpot_rule()])
+    fast = slow = 0
+    fired = []
+    for tick in range(16):
+        fast += 100
+        if tick == 8:
+            slow += 8                    # one blip: ~7% of one interval
+        store.ingest('svc', _expo(tpot_fast=fast, tpot_slow=slow),
+                     now=T0 + tick, leader_check=False)
+        fired += eng.evaluate(T0 + tick)
+    # The long windows dilute the blip below a sustained p95 breach.
+    assert fired == [], fired
+
+
+def test_alert_engine_dark_scrape_fires_on_ingest_gap(tmp_path):
+    store = obs_store.TelemetryStore(str(tmp_path / 'c.db'),
+                                     resolution=1.0)
+    rule = obs_alerts.AlertRule(name='dark', kind='missing',
+                                family=obs_alerts.DARK_SCRAPE_FAMILY,
+                                target=0.4)
+    eng = _engine(store, [rule])
+    for tick in range(5):
+        store.ingest('svc', _expo(requests=tick), now=T0 + tick,
+                     leader_check=False)
+        assert eng.evaluate(T0 + tick) == []
+    # Scrapes stop (controller frozen): the fast short window goes
+    # fully dark and the rule fires on the next evaluation.
+    (fire,) = eng.evaluate(T0 + 8)
+    assert fire['transition'] == 'fire' and fire['rule'] == 'dark'
+    # Ingest resumes: coverage recovers and the alert clears.
+    out = []
+    for tick in range(9, 13):
+        store.ingest('svc', _expo(requests=10 + tick), now=T0 + tick,
+                     leader_check=False)
+        out += eng.evaluate(T0 + tick)
+    assert [t['transition'] for t in out] == ['clear']
+
+
+def test_alert_engine_fresh_deployment_not_dark(tmp_path):
+    """first_t guards the missing rule: a store with no history at all
+    (brand-new deployment) must not instantly page 'dark'."""
+    store = obs_store.TelemetryStore(str(tmp_path / 'd.db'),
+                                     resolution=1.0)
+    rule = obs_alerts.AlertRule(name='dark', kind='missing',
+                                family=obs_alerts.DARK_SCRAPE_FAMILY,
+                                target=0.4)
+    eng = _engine(store, [rule])
+    assert eng.evaluate(T0) == []            # empty store: no data
+    # The controller's cadence: evaluate right after each ingest.  The
+    # window clamps to first_t, coverage is complete, still quiet.
+    for tick in range(3):
+        store.ingest('svc', _expo(requests=tick), now=T0 + tick,
+                     leader_check=False)
+        assert eng.evaluate(T0 + tick) == []
+
+
+def test_alert_engine_resumes_firing_set_from_store(tmp_path):
+    """A restarted control plane seeds its firing cache from the
+    durable rows — an alert that was firing is not re-fired."""
+    db = str(tmp_path / 'e.db')
+    store = obs_store.TelemetryStore(db, resolution=1.0)
+    store.fire_alert('svc', 'tpot', 'decode', T0, 3.0, '{}')
+    eng = _engine(obs_store.TelemetryStore(db, resolution=1.0),
+                  [_tpot_rule()])
+    # Still breaching: no new transition (already firing).
+    store.ingest('svc', _expo(tpot_slow=100), now=T0 + 1,
+                 leader_check=False)
+    store.ingest('svc', _expo(tpot_slow=200), now=T0 + 2,
+                 leader_check=False)
+    assert eng.evaluate(T0 + 2) == []
+    assert len(store.active_alerts('svc')) == 1
+
+
+def test_alert_engine_gauge_low_and_ratio(tmp_path):
+    store = obs_store.TelemetryStore(str(tmp_path / 'f.db'),
+                                     resolution=1.0)
+    rules = [
+        obs_alerts.AlertRule(name='pages', kind='gauge_low',
+                             family='skytpu_engine_kv_free_pages',
+                             target=8.0),
+        obs_alerts.AlertRule(name='shed', kind='ratio',
+                             family='skytpu_lb_shed_total',
+                             ratio_family='skytpu_lb_requests_total',
+                             target=0.05),
+    ]
+    eng = _engine(store, rules)
+    req = shed = 0
+    for tick in range(10):
+        req += 100
+        shed += 50                       # 50% shed: 10x the target
+        store.ingest('svc', _expo(requests=req, shed=shed,
+                                  free_pages=2.0),
+                     now=T0 + tick, leader_check=False)
+    fired = {t['rule'] for t in eng.evaluate(T0 + 9)}
+    assert fired == {'pages', 'shed'}
+
+
+# ---------------------------------------------------------------------------
+# skytpu top rendering
+# ---------------------------------------------------------------------------
+def test_top_snapshot_and_render(tmp_path):
+    store = obs_store.TelemetryStore(str(tmp_path / 'g.db'),
+                                     resolution=1.0)
+    roles = {'0': 'decode'}
+    fast = 0
+    for tick in range(10):
+        fast += 60
+        store.ingest('svc', _expo(tpot_fast=fast, requests=10 * tick,
+                                  free_pages=128.0),
+                     now=T0 + tick, roles=roles, leader_check=False)
+    store.fire_alert('svc', 'tpot_slo_burn', 'decode', T0 + 5, 2.1,
+                     '{}')
+    snap = obs_top.snapshot(store, 'svc', now=T0 + 10, window=10.0)
+    assert snap['service'] == 'svc'
+    decode = next(r for r in snap['pools'] if r['pool'] == 'decode')
+    assert decode['free_pages'] == pytest.approx(128.0)
+    assert decode['p95_tpot_s'] is not None
+    frame = obs_top.render(snap)
+    assert 'svc' in frame and 'POOL' in frame
+    assert 'tpot_slo_burn' in frame          # active alert surfaced
+    assert any(ch in frame for ch in obs_top.SPARK_CHARS[1:])
+    # sparkline is total-ordered and sized.
+    assert obs_top.sparkline([0, 1, 2, 3], width=4) == '▁▃▅█'
+    assert obs_top.sparkline([], width=4) == ''
+
+
+def test_top_run_single_frame_without_service(tmp_path, capsys):
+    store = obs_store.TelemetryStore(str(tmp_path / 'h.db'),
+                                     resolution=1.0)
+    assert obs_top.run(store, None, iterations=1) == 0
+    assert 'no telemetry yet' in capsys.readouterr().out
+    store.ingest('svc', _expo(requests=1), now=T0, leader_check=False)
+    assert obs_top.run(store, None, iterations=1) == 0
+    assert 'svc' in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# LB /alerts federation endpoint
+# ---------------------------------------------------------------------------
+def test_lb_alerts_endpoint(tmp_path, monkeypatch):
+    from test_observability import _free_port, _run_app_on_thread  # noqa: F401  pylint: disable=unused-import
+    from skypilot_tpu.serve.load_balancer import LoadBalancer
+    from skypilot_tpu.serve.load_balancing_policies import RoundRobinPolicy
+
+    db = str(tmp_path / 'state.db')
+    monkeypatch.setenv('SKYTPU_SERVE_DB', db)
+    store = obs_store.TelemetryStore(db, resolution=1.0)
+    store.fire_alert('alert-svc', 'tpot_slo_burn', 'decode', T0, 2.5,
+                     json.dumps({'300s': 2.5}))
+    store.fire_alert('other-svc', 'shed_rate', '', T0, 1.2, '{}')
+    lb = LoadBalancer('alert-svc', _free_port(), RoundRobinPolicy(),
+                      ready_urls_fn=lambda: [],
+                      ready_replicas_fn=lambda: [])
+    lb.start()
+    try:
+        with urllib.request.urlopen(lb.endpoint + '/alerts',
+                                    timeout=5) as resp:
+            doc = json.load(resp)
+    finally:
+        lb.stop()
+    assert doc['service'] == 'alert-svc'
+    (active,) = doc['active']                # other-svc filtered out
+    assert (active['rule'], active['pool'], active['burn']) == \
+        ('tpot_slo_burn', 'decode', 2.5)
+    assert doc['history'][0]['rule'] == 'tpot_slo_burn'

@@ -113,6 +113,45 @@ def test_radix_match_insert_evict_lru():
     assert pool.free_pages == 11
 
 
+def test_radix_fingerprint_tracks_content():
+    """The prefix-fingerprint gauge (skytpu_engine_prefix_fingerprint)
+    is a content digest of the cached prefix set: equal caches agree
+    across processes, disjoint prefixes disagree, and evicting an
+    insert returns the fingerprint to its prior value (XOR-accumulated
+    path digests are order-free and self-inverse)."""
+    def build(seqs):
+        pool = PagePool(64, 2)
+        cache = RadixCache(pool)
+        owners = []
+        for toks in seqs:
+            pages = pool.alloc(len(toks) // 2)
+            cache.insert(toks, pages)
+            owners.append(pages)
+        return pool, cache, owners
+
+    a_seqs = [[1, 2, 3, 4, 5, 6], [1, 2, 7, 8]]
+    _, a, _ = build(a_seqs)
+    _, b, _ = build(list(reversed(a_seqs)))      # same content
+    _, c, _ = build([[9, 9, 8, 8], [7, 7]])      # disjoint prefixes
+    assert a.fingerprint != 0
+    assert a.fingerprint == b.fingerprint
+    assert a.fingerprint != c.fingerprint
+
+    # Eviction is the exact inverse of insertion.
+    pool, cache, owners = build([[1, 2, 3, 4]])
+    before = cache.fingerprint
+    extra = pool.alloc(2)
+    cache.insert([1, 2, 5, 6], extra)            # shares the [1,2] page
+    assert cache.fingerprint != before
+    pool.release(extra)
+    cache.evict(1)                               # drops the [5,6] leaf
+    assert cache.fingerprint == before
+    for pages in owners:
+        pool.release(pages)
+    cache.evict(100)
+    assert cache.fingerprint == 0                # empty cache digests 0
+
+
 def test_radix_never_evicts_live_pages():
     pool = PagePool(6, 2)
     cache = RadixCache(pool)

@@ -433,6 +433,55 @@ def test_lb_scrape_age_gauge_exported_and_pruned():
         lb.stop()
 
 
+def test_lb_scrape_age_rebaselined_on_flap():
+    """Regression: a replica that flaps ready -> notready -> ready must
+    come back with a FRESH age baseline.  A scrape completion that was
+    in flight when the replica left used to replant its _scrape_ok_at
+    entry after the prune, so the readmitted replica inherited the dead
+    incarnation's (possibly ancient) scrape success — surfacing a
+    bogus multi-hour age the moment it rejoined."""
+    import time as time_lib
+
+    from aiohttp import web
+    from skypilot_tpu.serve.load_balancer import LoadBalancer
+    from skypilot_tpu.serve.load_balancing_policies import RoundRobinPolicy
+
+    app = web.Application()
+
+    async def metrics_route(_request):
+        return web.Response(text='# TYPE x gauge\nx 1\n',
+                            content_type='text/plain')
+
+    app.router.add_get('/metrics', metrics_route)
+    port, stop_replica = _run_app_on_thread(app)
+    url = f'http://127.0.0.1:{port}'
+    ready = [(7, url)]
+    lb = LoadBalancer('flap-svc', _free_port(), RoundRobinPolicy(),
+                      ready_urls_fn=lambda: [u for _, u in ready],
+                      ready_replicas_fn=lambda: list(ready))
+    lb.start()
+    try:
+        _get(lb.endpoint + '/metrics')          # scraped ok, age ~0
+        ready.clear()
+        _get(lb.endpoint + '/metrics')          # flap out: state pruned
+        # Simulate the write-after-prune replant with an ancient
+        # baseline (the in-handler guard now refuses this write for a
+        # non-ready URL; even a survivor must not outlive readmission).
+        lb._scrape_ok_at[url] = time_lib.monotonic() - 9999.0
+        ready.append((7, url))
+        _get(lb.endpoint + '/metrics')          # flap back in
+        m = re.search(
+            r'skytpu_lb_scrape_age_seconds\{replica="7",'
+            r'service="flap-svc"\} ([0-9.]+)', metrics.render())
+        assert m is not None, metrics.render()
+        assert float(m.group(1)) < 5.0, (
+            'readmitted replica inherited its previous incarnation\'s '
+            f'scrape-age baseline: {m.group(1)}s')
+    finally:
+        lb.stop()
+        stop_replica()
+
+
 # ----- jobs postmortem surface (API server /debug dump) -----------------------
 def test_jobs_events_dumpable_via_api_server_debug(tmp_home,
                                                    enable_all_clouds):

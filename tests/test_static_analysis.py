@@ -178,6 +178,18 @@ def test_fixture_findings_land_where_expected():
     fleet_msgs = ' '.join(f.message for f in fleet_hits)
     assert 'skytpu_fleetsim_tick_millis' in fleet_msgs
     assert 'skytpu_fleetsim_rogue_total' in fleet_msgs
+    # Obs fixture: AlertRule family references are held to the same
+    # registry — unregistered literal, module-constant, and
+    # ratio_family denominator are each caught; the rule built from a
+    # registered metrics_lib constant is clean.
+    obs_hits = [f for f in by_rule['metric-naming']
+                if f.path == 'obs/bad_alert_rule.py']
+    assert len(obs_hits) == 3
+    obs_msgs = ' '.join(f.message for f in obs_hits)
+    assert 'skytpu_obs_rogue_seconds' in obs_msgs
+    assert 'skytpu_engine_rogue_latency_seconds' in obs_msgs
+    assert 'skytpu_lb_rogue_total' in obs_msgs
+    assert all('can never fire' in f.message for f in obs_hits)
     # speculation: the jit-inside-propose/verify hazard AND the
     # unpinned verify program — both from the speculation fixture,
     # and ONLY from it (the engine's real verify wiring is clean).
