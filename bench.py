@@ -857,6 +857,158 @@ def bench_obs_overhead(on_tpu: bool) -> dict:
     }
 
 
+def bench_goodput(on_tpu: bool) -> dict:
+    """Training goodput plane (goodput ledger + straggler detection).
+
+    Three measured contracts, each pinned by test_readme_bench once
+    this lands in an artifact:
+
+      - **ledger-vs-wall agreement <1%**: a real (tiny, one-chip)
+        Trainer run with real orbax checkpoints and an injected
+        preemption — incarnation 1 dies after its checkpoint,
+        controller-style downtime rows are written, incarnation 2
+        restores and finishes — and the durable ledger's categories
+        must re-tile the externally measured wall-clock;
+      - **instrumentation overhead <1%**: the per-step hot-loop
+        additions (two perf_counter stamps, the input-stall carve, the
+        host-labeled step histogram) microbenched the same
+        strictly-additive way as the tracing/obs benches, priced
+        against the run's own measured step time;
+      - the **sim validation**: the fleetsim goodput scenario (planted
+        slow host, injected preemption on a sim clock) driven through
+        the production store/skew/alert path — exact tiling, skew
+        attribution to the planted host, goodput_low + straggler
+        firing.
+    """
+    del on_tpu  # tiny model everywhere: the plane under test is
+    # clock/ledger arithmetic, not matmuls
+    import os
+    import tempfile
+    from skypilot_tpu.fleetsim.goodput_run import (GoodputScenario,
+                                                   run_goodput_sim)
+    from skypilot_tpu.models.llama import LLAMA_CONFIGS, Llama
+    from skypilot_tpu.obs import goodput as goodput_lib
+    from skypilot_tpu.parallel.mesh import build_mesh, plan_mesh
+    from skypilot_tpu.server import metrics as metrics_lib
+    from skypilot_tpu.server import tracing
+    from skypilot_tpu.train.trainer import TrainConfig, Trainer
+
+    tmp = tempfile.mkdtemp(prefix='skytpu-bench-goodput-')
+    ledger = goodput_lib.GoodputLedger(os.path.join(tmp, 'jobs.db'))
+    job = 'bench'
+    rid = f'job-{job}'
+
+    cfg = LLAMA_CONFIGS['tiny']
+    seq, batch, steps1, steps2 = 64, 4, 12, 12
+    mesh = build_mesh(plan_mesh(1), jax.devices()[:1])
+    model = Llama(cfg, mesh)
+    rng = jax.random.PRNGKey(0)
+    tokens = jax.random.randint(rng, (batch, seq), 0, cfg.vocab_size)
+    ckpt_dir = os.path.join(tmp, 'ckpt')
+
+    def data_iter():
+        while True:
+            yield tokens
+
+    # ---- incarnation 1: train, checkpoint, "lose the slice" ----------
+    wall0 = time.perf_counter()
+    rec1 = goodput_lib.PhaseRecorder(job=job, ledger=ledger, rid=rid)
+    trainer = Trainer(model, mesh, rng, tokens,
+                      TrainConfig(warmup_steps=2, total_steps=100),
+                      checkpoint_dir=ckpt_dir, phases=rec1)
+    trainer.run(data_iter(), steps1, checkpoint_every=6, log_every=6)
+    rec1.close()    # the worker dies with its slice
+    # ---- controller: detect, teardown, relaunch (jobs/controller
+    # _record_downtime semantics, compressed sleeps) -------------------
+    lost_p = time.perf_counter()
+    time.sleep(0.05)
+    rec_p = time.perf_counter()
+    time.sleep(0.05)
+    up_p = time.perf_counter()
+    for cat, p0, p1 in (
+            (goodput_lib.PREEMPTION_DOWNTIME, lost_p, rec_p),
+            (goodput_lib.RECOVERY_RELAUNCH, rec_p, up_p)):
+        tracing.record_span(rid, goodput_lib.DOWNTIME_SPAN, p0, p1,
+                            category=cat)
+        ledger.add(job, cat, p1 - p0, t0=tracing.wall_of(p0),
+                   t1=tracing.wall_of(p1))
+    # ---- incarnation 2: restore and finish ---------------------------
+    rec2 = goodput_lib.PhaseRecorder(job=job, ledger=ledger, rid=rid)
+    trainer2 = Trainer(model, mesh, rng, tokens,
+                       TrainConfig(warmup_steps=2, total_steps=100),
+                       checkpoint_dir=ckpt_dir, phases=rec2)
+    resumed_step = trainer2.restore_if_available()
+    out = trainer2.run(data_iter(), steps2, checkpoint_every=6,
+                       log_every=6)
+    rec2.close()
+    wall_s = time.perf_counter() - wall0
+
+    totals = ledger.totals(job)
+    ledger_wall = sum(totals.values())
+    # Ledger intervals vs flight-recorder span timestamps for the
+    # injected preemption (the ±1 s acceptance check).
+    ev_starts = {e['attrs']['category']: e['ts']
+                 for e in tracing.events_for(rid)
+                 if e['name'] == goodput_lib.DOWNTIME_SPAN}
+    deltas = [abs(iv['t0'] - ev_starts[cat])
+              for cat in (goodput_lib.PREEMPTION_DOWNTIME,
+                          goodput_lib.RECOVERY_RELAUNCH)
+              if cat in ev_starts
+              for iv in ledger.intervals(job, cat)]
+    event_delta_s = max(deltas) if deltas else None
+
+    # ---- per-step instrumentation cost (strictly additive) -----------
+    rec = goodput_lib.PhaseRecorder()
+    rec.begin(goodput_lib.PRODUCTIVE)
+    n, per_batch = 20_000, []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            f0 = time.perf_counter()
+            stall = time.perf_counter() - f0
+            rec.carve(goodput_lib.INPUT_STALL, stall + 1e-12)
+            metrics_lib.observe_hist('skytpu_train_step_seconds',
+                                     0.01, host='host0')
+        per_batch.append((time.perf_counter() - t0) / n)
+    instr_s_per_step = min(per_batch)
+    # Price it against this run's own productive step time.
+    step_time_s = tokens.size / out['tokens_per_s']
+    overhead_pct = 100.0 * instr_s_per_step / step_time_s
+
+    # ---- sim validation (planted slow host, sim clock) ---------------
+    sim = run_goodput_sim(
+        GoodputScenario(slow_host=2),
+        ledger_dsn=os.path.join(tmp, 'sim_ledger.db'),
+        store_dsn=os.path.join(tmp, 'sim_store.db'))
+
+    return {
+        'goodput_pct': round(ledger.goodput_pct(job), 2),
+        'badput_s': {c: round(s, 4) for c, s in sorted(totals.items())
+                     if c != goodput_lib.PRODUCTIVE},
+        'productive_s': round(totals.get(goodput_lib.PRODUCTIVE, 0.0),
+                              4),
+        'wall_s': round(wall_s, 4),
+        'ledger_wall_s': round(ledger_wall, 4),
+        'ledger_vs_wall_pct': round(
+            100.0 * abs(ledger_wall - wall_s) / wall_s, 4),
+        'preemption_event_delta_s': (round(event_delta_s, 4)
+                                     if event_delta_s is not None
+                                     else None),
+        'resumed_from_step': resumed_step,
+        'instr_us_per_step': round(instr_s_per_step * 1e6, 3),
+        'overhead_pct': round(overhead_pct, 4),
+        'sim': {
+            'goodput_pct': round(sim['goodput_pct'], 2),
+            'ledger_vs_wall_pct': round(sim['ledger_vs_wall_pct'], 6),
+            'skew': round(sim['skew']['skew'], 2) if sim['skew']
+                    else None,
+            'slow_host': (sim['skew'] or {}).get('slow_host'),
+            'active_alerts': sim['active_alerts'],
+            'downtime_s': round(sim['downtime_s'], 2),
+        },
+    }
+
+
 def bench_slo_ramp(plateau_ticks: int = 12) -> dict:
     """SLO-aware vs QPS-only autoscaling under a synthetic traffic ramp
     (virtual replicas, virtual time — hermetic and chip-free).
@@ -1211,6 +1363,13 @@ def main(argv=None) -> None:
     jax.clear_caches()
     gc.collect()
     serve['obs'] = bench_obs_overhead(on_tpu)
+    # Training goodput plane: ledger-vs-wall agreement on a real
+    # checkpointed run with an injected preemption + the sim-clock
+    # straggler/alert validation (tiny model — runs last so its
+    # registry resets never race the scrape-based sections).
+    jax.clear_caches()
+    gc.collect()
+    train['goodput'] = bench_goodput(on_tpu)
     print(json.dumps({
         'metric': 'llama_train_mfu_single_chip',
         'value': train['mfu_pct'],

@@ -844,20 +844,61 @@ def jobs_launch(entrypoint, cluster, detach_run, **overrides):
 @jobs.command('queue')
 def jobs_queue_cmd():
     """List managed jobs."""
+    from skypilot_tpu.obs import goodput as goodput_lib
+    # Recovery cost per job from the goodput ledger (one query for the
+    # whole listing): preemption downtime + relaunch seconds, summed
+    # across every recovery the job has survived.
+    downtime = goodput_lib.GoodputLedger().downtime_by_job()
     rows = []
     for r in sdk.jobs_queue():
         n_tasks = r.get('num_tasks', 1)
         task_col = (f'{r.get("task_index", 0) + 1}/{n_tasks}'
                     if n_tasks > 1 else '-')
+        down = downtime.get(str(r['job_id']), 0.0)
         rows.append([
             r['job_id'], r.get('name') or '-', r['status'], task_col,
             r.get('cluster_name') or '-',
             r.get('recovery_count', 0),
+            f'{down:.1f}' if down else '-',
             (r.get('failure_reason') or '')[:40],
         ])
     ux_utils.print_table(
         ['ID', 'NAME', 'STATUS', 'TASK', 'CLUSTER', 'RECOVERIES',
-         'REASON'], rows)
+         'DOWNTIME_S', 'REASON'], rows)
+
+
+@jobs.command('top')
+@click.argument('job_id')
+@click.option('--db', 'db_url', default=None,
+              help='Telemetry store holding the job\'s step-time '
+                   'scrapes — a sqlite path or postgres:// DSN '
+                   '(default: the local serve state database).')
+@click.option('--ledger-db', default=None,
+              help='Goodput ledger DSN (default: the managed-jobs '
+                   'database).')
+@click.option('--interval', default=2.0, show_default=True,
+              help='Refresh period in seconds.')
+@click.option('--iterations', default=None, type=int,
+              help='Render this many frames then exit (default: run '
+                   'until Ctrl-C; pass 1 for a postmortem print).')
+@click.option('--window', default=300.0, show_default=True,
+              help='Aggregation window in seconds for the per-host '
+                   'table and sparklines.')
+def jobs_top_cmd(job_id, db_url, ledger_db, interval, iterations,
+                 window):
+    """Live per-job goodput view: goodput %, badput breakdown,
+    per-host step-time sparklines + straggler skew, and the recovery
+    timeline — still renders a dead job's postmortem from the durable
+    ledger."""
+    from skypilot_tpu.obs import goodput as goodput_lib
+    from skypilot_tpu.obs import jobs_top as obs_jobs_top
+    from skypilot_tpu.obs import store as obs_store
+    from skypilot_tpu.serve import serve_state
+    ledger = goodput_lib.GoodputLedger(ledger_db)
+    store = obs_store.TelemetryStore(db_url or serve_state._db_path())
+    raise SystemExit(obs_jobs_top.run(
+        job_id, ledger=ledger, store=store, interval=interval,
+        iterations=iterations, window=window))
 
 
 @jobs.command('cancel')

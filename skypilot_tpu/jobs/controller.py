@@ -42,6 +42,7 @@ from skypilot_tpu.jobs import recovery_strategy as recovery_lib
 from skypilot_tpu.jobs import state
 from skypilot_tpu.jobs.recovery_strategy import StrategyExecutor
 from skypilot_tpu.jobs.state import ManagedJobStatus
+from skypilot_tpu.obs import goodput as goodput_lib
 from skypilot_tpu.server import metrics as metrics_lib
 from skypilot_tpu.server import tracing
 
@@ -230,6 +231,29 @@ class JobController:
         finally:
             maybe_start_controllers()
 
+    def _record_downtime(self, job_id: int, up_p: float, rec_p: float,
+                         end_p: float) -> float:
+        """Write one recovery's goodput intervals — durable ledger rows
+        plus their flight-recorder twins: ``preemption_downtime`` spans
+        last-healthy-poll -> recovery dispatch (the true loss instant
+        is inside it, within one poll interval), ``recovery_relaunch``
+        spans dispatch -> RUNNING again.  Lost-job/user-failure
+        resubmits pass ``up_p == rec_p`` (the cluster never went down)
+        and record only the relaunch.  Returns the new healthy-poll
+        anchor."""
+        ledger = goodput_lib.GoodputLedger()
+        rid = f'job-{job_id}'
+        for cat, p0, p1 in (
+                (goodput_lib.PREEMPTION_DOWNTIME, up_p, rec_p),
+                (goodput_lib.RECOVERY_RELAUNCH, rec_p, end_p)):
+            if p1 <= p0:
+                continue
+            tracing.record_span(rid, goodput_lib.DOWNTIME_SPAN, p0, p1,
+                                category=cat)
+            ledger.add(str(job_id), cat, p1 - p0,
+                       t0=tracing.wall_of(p0), t1=tracing.wall_of(p1))
+        return end_p
+
     def _run_task(self, rec: dict, strategy: StrategyExecutor,
                   max_restarts: int) -> '_TaskOutcome':
         job_id = self.job_id
@@ -253,6 +277,11 @@ class JobController:
         # count — the original job may still be running, and resubmitting
         # over it would run two copies concurrently.
         unknown_streak = 0
+        # Goodput ledger anchor: the last poll that confirmed the
+        # cluster healthy.  A preemption's downtime interval starts
+        # here — the true loss instant is unobservable, but it lies
+        # within one poll interval of this stamp.
+        last_up_p = time.perf_counter()
         while True:
             _check_shutdown()
             if self._cancel_requested():
@@ -299,9 +328,12 @@ class JobController:
                         f'resubmitting (recovery #{n}).')
                     unknown_streak = 0
                     state.set_status(job_id, ManagedJobStatus.RECOVERING)
+                    rec_p = time.perf_counter()
                     cluster_job_id = strategy.launch()
                     state.set_cluster(job_id, cluster_name, cluster_job_id)
                     state.set_status(job_id, ManagedJobStatus.RUNNING)
+                    last_up_p = self._record_downtime(
+                        job_id, rec_p, rec_p, time.perf_counter())
                     continue
             else:
                 unknown_streak = 0
@@ -327,9 +359,12 @@ class JobController:
                 if self._cancel_requested():
                     self._finish_cancel(strategy, None)
                     return _TaskOutcome.CANCELLED
+                rec_p = time.perf_counter()
                 cluster_job_id = strategy.recover()
                 state.set_cluster(job_id, cluster_name, cluster_job_id)
                 state.set_status(job_id, ManagedJobStatus.RUNNING)
+                last_up_p = self._record_downtime(
+                    job_id, last_up_p, rec_p, time.perf_counter())
                 unknown_streak = 0
                 continue
             if status is ClusterJobStatus.FAILED_SETUP:
@@ -366,14 +401,18 @@ class JobController:
                     f'Managed job {job_id}: user-code failure, '
                     f'restart {n}/{max_restarts}.')
                 state.set_status(job_id, ManagedJobStatus.RECOVERING)
+                rec_p = time.perf_counter()
                 cluster_job_id = strategy.launch()  # cluster is UP;
                 # launch reuses it and just resubmits the job.
                 state.set_cluster(job_id, cluster_name, cluster_job_id)
                 state.set_status(job_id, ManagedJobStatus.RUNNING)
+                last_up_p = self._record_downtime(
+                    job_id, rec_p, rec_p, time.perf_counter())
                 unknown_streak = 0
                 continue
             # RUNNING / PENDING / SETTING_UP on a healthy cluster (or a
             # transient agent hiccup): poll again (shutdown-interruptible).
+            last_up_p = time.perf_counter()
             _shutdown.wait(_poll_interval())
 
 

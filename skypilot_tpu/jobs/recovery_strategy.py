@@ -92,6 +92,9 @@ class StrategyExecutor:
         Raises ResourcesUnavailableError when every placement is exhausted
         (the controller maps that to FAILED_NO_RESOURCE).
         """
+        import time as time_lib
+
+        from skypilot_tpu.obs import goodput as goodput_lib
         from skypilot_tpu.server import metrics as metrics_lib
         from skypilot_tpu.server import tracing
         metrics_lib.inc_counter('skytpu_jobs_recovery_launches_total',
@@ -99,6 +102,21 @@ class StrategyExecutor:
         tracing.record_instant(f'cluster-{self.cluster_name}',
                                'jobs.recovery_launch',
                                strategy=self.strategy.value)
+        # Cluster-rid twin of the controller's job-rid downtime span:
+        # how long THIS slice's teardown + re-provision + resubmit took
+        # (the controller owns the ledger write; this is trace-only, so
+        # the seconds are never double-counted).
+        t0 = time_lib.perf_counter()
+        try:
+            return self._recover_inner()
+        finally:
+            tracing.record_span(f'cluster-{self.cluster_name}',
+                                goodput_lib.DOWNTIME_SPAN, t0,
+                                time_lib.perf_counter(),
+                                category=goodput_lib.RECOVERY_RELAUNCH,
+                                strategy=self.strategy.value)
+
+    def _recover_inner(self) -> int:
         record = global_user_state.get_cluster(self.cluster_name)
         if record is not None:
             if self.strategy is StrategyName.EAGER_FAILOVER:

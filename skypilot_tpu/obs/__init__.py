@@ -14,7 +14,11 @@ from the controller's existing federated-LB scrapes:
 - ``alerts``   — declarative SLO rules evaluated as multi-window burn
   rates over the store, firing/clearing durable alert rows with
   hysteresis and recording flight-recorder instants;
-- ``top``      — the terminal fleet view over the same query API.
+- ``top``      — the terminal fleet view over the same query API;
+- ``goodput``  — the training goodput plane (ISSUE 20): a durable
+  wall-clock ledger (productive vs badput categories, summing across
+  preemptions/recoveries) plus per-host step-time straggler skew,
+  feeding `train_rules` and `skytpu jobs top`.
 
 The fleetsim chaos run ingests sim-time telemetry through the same
 code path, so the canonical storm's alert timeline is test-pinned
@@ -24,6 +28,12 @@ from skypilot_tpu.obs.alerts import AlertEngine
 from skypilot_tpu.obs.alerts import AlertRule
 from skypilot_tpu.obs.alerts import BurnWindows
 from skypilot_tpu.obs.alerts import default_rules
+from skypilot_tpu.obs.alerts import train_rules
+from skypilot_tpu.obs.goodput import GoodputLedger
+from skypilot_tpu.obs.goodput import PhaseRecorder
+from skypilot_tpu.obs.goodput import evaluate_stragglers
+from skypilot_tpu.obs.goodput import step_time_skew
+from skypilot_tpu.obs.goodput import train_obs_tick
 from skypilot_tpu.obs.store import Downsampler
 from skypilot_tpu.obs.store import TelemetryStore
 
@@ -32,6 +42,12 @@ __all__ = [
     'AlertRule',
     'BurnWindows',
     'default_rules',
+    'train_rules',
+    'GoodputLedger',
+    'PhaseRecorder',
+    'evaluate_stragglers',
+    'step_time_skew',
+    'train_obs_tick',
     'Downsampler',
     'TelemetryStore',
 ]

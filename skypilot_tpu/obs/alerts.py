@@ -23,6 +23,9 @@ Burn semantics per kind (burn >= 1.0 means "out of SLO"):
   target fraction — ``(num / den) / target``;
 - ``gauge_low``: a floor on the worst per-replica gauge in the window
   (free pages, spec acceptance) — ``target / min_value``;
+- ``gauge_high``: the symmetric ceiling — ``max_value / target`` —
+  for signals where HIGH is bad (step-time skew: a straggling host
+  drags every synchronous step to its pace);
 - ``missing``: fraction of resolution intervals with NO ingest
   heartbeat vs a target fraction — the dark-scrape signal.  Evaluated
   on the fast short window only (absence is inherently a now-signal,
@@ -75,7 +78,7 @@ class AlertRule:
     pool-tagged only when the scrape carries replica labels.
     """
     name: str
-    kind: str  # latency_burn | ratio | gauge_low | missing
+    kind: str  # latency_burn|ratio|gauge_low|gauge_high|missing
     family: str
     pool: str = ''
     target: float = 1.0
@@ -86,7 +89,7 @@ class AlertRule:
 
     def __post_init__(self) -> None:
         if self.kind not in ('latency_burn', 'ratio', 'gauge_low',
-                             'missing'):
+                             'gauge_high', 'missing'):
             raise ValueError(f'unknown alert rule kind: {self.kind!r}')
         if self.kind == 'ratio' and not self.ratio_family:
             raise ValueError(
@@ -118,6 +121,25 @@ def default_rules(target_ttft_ms: float, target_tpot_ms: float,
         AlertRule(name='kv_free_pages_exhausted', kind='gauge_low',
                   family='skytpu_engine_kv_free_pages',
                   pool='decode', target=8.0),
+    )
+
+
+def train_rules(goodput_target_pct: float = 80.0,
+                skew_target: float = 1.3) -> Tuple[AlertRule, ...]:
+    """The training-job rule set (ISSUE 20): `goodput_low` fires when
+    the job's goodput gauge sags under the target percentage on both
+    windows of a pair; `straggler` fires when the per-window host skew
+    (max-host p50 / median-host p50, written by
+    obs/goodput.evaluate_stragglers) sustains above `skew_target` —
+    on a synchronous job the whole pod runs at the slow host's pace,
+    so skew IS the badput multiplier."""
+    return (
+        AlertRule(name='goodput_low', kind='gauge_low',
+                  family=metrics_lib.TRAIN_GOODPUT_FAMILY,
+                  pool='train', target=float(goodput_target_pct)),
+        AlertRule(name='straggler', kind='gauge_high',
+                  family=metrics_lib.TRAIN_STEP_SKEW_FAMILY,
+                  pool='train', target=float(skew_target)),
     )
 
 
@@ -171,6 +193,11 @@ class AlertEngine:
             if worst <= 0:
                 return math.inf
             return rule.target / worst
+        if rule.kind == 'gauge_high':
+            worst = s.gauge_max(self.service, rule.family, t0, t1)
+            if worst is None or rule.target <= 0:
+                return None
+            return worst / rule.target
         # kind == 'missing': coverage gaps in the family's intervals,
         # counted only over history the store actually reaches back to.
         first = s.first_t(self.service, rule.family)

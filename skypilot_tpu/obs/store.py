@@ -64,7 +64,15 @@ HISTOGRAM_FAMILIES: Tuple[str, ...] = (
     metrics_lib.ENGINE_TTFT_FAMILY,
     metrics_lib.ENGINE_TPOT_FAMILY,
     'skytpu_lb_request_duration_seconds',
+    metrics_lib.TRAIN_STEP_FAMILY,
 )
+# histogram family -> sub-label kept through downsampling (lands in
+# the `replica` column, one distribution per label value).  Step-time
+# histograms keep their `host` label so straggler skew (max-host p50 /
+# median-host p50, obs/goodput.py) is derivable from store rows alone.
+HISTOGRAM_SUB_FAMILIES: Dict[str, str] = {
+    metrics_lib.TRAIN_STEP_FAMILY: 'host',
+}
 # family -> sub-label whose value keys the `bucket` column (None:
 # aggregate every series of the family into one row per interval).
 COUNTER_FAMILIES: Dict[str, Optional[str]] = {
@@ -84,6 +92,12 @@ GAUGE_FAMILIES: Tuple[str, ...] = (
     'skytpu_engine_mfu',
     metrics_lib.QUEUED_PREFILL_TOKENS_FAMILY,
     'skytpu_lb_scrape_age_seconds',
+    # Training goodput plane: the headline goodput gauge from worker
+    # scrapes; the skew gauge is DERIVED controller-side
+    # (obs/goodput.evaluate_stragglers writes it via put_gauge) but
+    # listed so a federated re-scrape of a controller round-trips it.
+    metrics_lib.TRAIN_GOODPUT_FAMILY,
+    metrics_lib.TRAIN_STEP_SKEW_FAMILY,
 )
 
 _DDL = [
@@ -178,11 +192,14 @@ class Downsampler:
                 ) -> Dict[str, Dict[tuple, float]]:
         """One scrape in, pool-aggregated deltas/gauges out.
 
-        Returns ``{'hist': {(family, pool, le_text): delta},
+        Returns ``{'hist': {(family, pool, sub, le_text): delta},
         'counters': {(family, pool, bucket): delta},
         'gauges': {(family, pool, replica): value}}``.  ``roles`` maps
         replica label -> pool name for pool attribution; unlabeled or
-        unknown series land under pool ''.
+        unknown series land under pool ''.  ``sub`` is the series'
+        HISTOGRAM_SUB_FAMILIES label value ('' for families without a
+        sub-label); it lands in the store's ``replica`` column so
+        per-host step-time distributions survive downsampling.
         """
         roles = roles or {}
         hist: Dict[tuple, float] = {}
@@ -202,10 +219,13 @@ class Downsampler:
                         for le, count in prev.items()):
                     continue  # new series or reset: baseline only
                 pool = self._pool_of(skey, roles)
+                sub_label = HISTOGRAM_SUB_FAMILIES.get(family)
+                sub = (dict(skey).get(sub_label, '')
+                       if sub_label else '')
                 for le, count in cum.items():
                     delta = count - prev.get(le, 0.0)
                     if delta > 0.0:
-                        k = (family, pool, _le_text(le))
+                        k = (family, pool, sub, _le_text(le))
                         hist[k] = hist.get(k, 0.0) + delta
 
         for name, labels, value in samples:
@@ -304,9 +324,9 @@ class TelemetryStore:
             'ON CONFLICT(service, pool, replica, family, bucket, t) '
             'DO UPDATE SET value = excluded.value')
         with db_utils.transaction(dsn) as conn:
-            for (family, pool, bucket), delta in \
+            for (family, pool, sub, bucket), delta in \
                     deltas['hist'].items():
-                conn.execute(add_sql, (service, pool, '', family,
+                conn.execute(add_sql, (service, pool, sub, family,
                                        bucket, tb, delta))
             for (family, pool, bucket), delta in \
                     deltas['counters'].items():
@@ -363,6 +383,27 @@ class TelemetryStore:
             agg[le] = agg.get(le, 0.0) + float(row['value'])
         return agg
 
+    def histogram_window_by_replica(self, service: str, family: str,
+                                    t0: float, t1: float
+                                    ) -> Dict[str, Dict[float, float]]:
+        """Per-replica-column bucket counts in ``(t0, t1]`` — for
+        sub-labeled histogram families (HISTOGRAM_SUB_FAMILIES) the
+        replica column holds the sub-label value (e.g. ``host``), so
+        this is the per-host step-time distribution the straggler
+        detector compares quantiles across."""
+        sql = ('SELECT replica, bucket, value FROM obs_samples WHERE '
+               'service=? AND family=? AND t > ? AND t <= ?')
+        out: Dict[str, Dict[float, float]] = {}
+        for row in db_utils.query(self._ensure(), sql,
+                                  (service, family, t0, t1)):
+            try:
+                le = _le_value(row['bucket'])
+            except ValueError:
+                continue
+            agg = out.setdefault(row['replica'], {})
+            agg[le] = agg.get(le, 0.0) + float(row['value'])
+        return out
+
     def quantile(self, service: str, family: str, t0: float, t1: float,
                  q: float, pool: Optional[str] = None
                  ) -> Optional[float]:
@@ -399,6 +440,36 @@ class TelemetryStore:
         if row is None or row['m'] is None:
             return None
         return float(row['m'])
+
+    def gauge_max(self, service: str, family: str, t0: float, t1: float,
+                  pool: Optional[str] = None) -> Optional[float]:
+        """Worst (highest) gauge value in the window — the ceiling
+        signal for gauge_high rules (step-time skew)."""
+        sql = ('SELECT MAX(value) AS m FROM obs_samples WHERE '
+               'service=? AND family=? AND t > ? AND t <= ?')
+        params: list = [service, family, t0, t1]
+        if pool is not None:
+            sql += ' AND pool=?'
+            params.append(pool)
+        row = db_utils.query_one(self._ensure(), sql, tuple(params))
+        if row is None or row['m'] is None:
+            return None
+        return float(row['m'])
+
+    def put_gauge(self, service: str, family: str, value: float,
+                  now: float, pool: str = '', replica: str = '') -> None:
+        """Write one DERIVED gauge interval directly (not via a
+        scrape) — how the controller lands computed signals like
+        step-time skew in the same table its alert rules read."""
+        db_utils.execute(
+            self._ensure(),
+            'INSERT INTO obs_samples '
+            '(service, pool, replica, family, bucket, t, value) '
+            'VALUES (?,?,?,?,?,?,?) '
+            'ON CONFLICT(service, pool, replica, family, bucket, t) '
+            'DO UPDATE SET value = excluded.value',
+            (service, pool, replica, family, '', self.bucket_t(now),
+             float(value)))
 
     def gauge_latest(self, service: str, family: str,
                      replica: Optional[str] = None,

@@ -175,9 +175,15 @@ _HELP = {
         'decode replica\'s generation (the adopt response carries the '
         'completion)',
     # ----- training -------------------------------------------------------
-    'skytpu_train_step_seconds': 'Train step wall time',
+    'skytpu_train_step_seconds':
+        'Train step wall time, per host (the host label is '
+        'jax.process_index() — the straggler skew gauge is derived '
+        'from the per-host distributions the telemetry store keeps)',
     'skytpu_train_tokens_per_second':
-        'Training throughput over the recent logging window',
+        'Training throughput over the recent logging window, per '
+        'PRODUCTIVE second (goodput-ledger-classified badput — '
+        'checkpoint saves, input stalls — is excluded from the '
+        'denominator)',
     'skytpu_train_mfu_percent':
         'Estimated model FLOPs utilization (bench.py accounting)',
     'skytpu_train_hbm_bytes_per_token':
@@ -186,6 +192,21 @@ _HELP = {
         'amortized over the step\'s tokens — train/flops.py)',
     'skytpu_train_arith_intensity':
         'Modeled training arithmetic intensity (FLOPs/HBM byte)',
+    # ----- training goodput plane (obs/goodput.py) -------------------------
+    'skytpu_train_goodput_percent':
+        'Share of this run\'s classified wall-clock spent in '
+        'productive step time (goodput ledger headline: productive / '
+        'wall * 100; the durable, recovery-summed twin lives in the '
+        'goodput_ledger table)',
+    'skytpu_train_badput_seconds_total':
+        'Non-productive wall-clock by ledger category (init_compile / '
+        'checkpoint_save / checkpoint_restore / input_stall / '
+        'preemption_downtime / recovery_relaunch)',
+    'skytpu_train_step_skew':
+        'Multi-host step-time skew over the recent window: slowest '
+        'host\'s p50 step time over the median host\'s — 1.0 is a '
+        'balanced slice, the straggler alert rule fires on sustained '
+        'excess',
     # ----- managed jobs ----------------------------------------------------
     'skytpu_jobs_preemptions_total':
         'Task clusters lost to preemption (cloud says not-UP)',
@@ -291,6 +312,13 @@ _BUCKETS: Dict[str, Tuple[float, ...]] = {
 QUEUED_PREFILL_TOKENS_FAMILY = 'skytpu_engine_queued_prefill_tokens'
 ENGINE_TTFT_FAMILY = 'skytpu_engine_ttft_seconds'
 ENGINE_TPOT_FAMILY = 'skytpu_engine_inter_token_seconds'
+# Training goodput plane: the trainer exports these, the telemetry
+# store downsamples them (per-host for the step histogram), and the
+# obs alert rules / `skytpu jobs top` read them back.
+TRAIN_STEP_FAMILY = 'skytpu_train_step_seconds'
+TRAIN_GOODPUT_FAMILY = 'skytpu_train_goodput_percent'
+TRAIN_BADPUT_FAMILY = 'skytpu_train_badput_seconds_total'
+TRAIN_STEP_SKEW_FAMILY = 'skytpu_train_step_skew'
 # Response header the inference server stamps the queued-prefill-token
 # backlog on; the serve LB reads it on the proxy response path (same
 # cross-process contract as the gauge above, same drift risk).

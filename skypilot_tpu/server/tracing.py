@@ -144,6 +144,18 @@ SPAN_HELP = {
         '(preemption / lost_job / user_failure)',
     'jobs.recovery_launch':
         'Recovery relaunch dispatched (slice delete + re-provision)',
+    'jobs.downtime':
+        'One controller-observed goodput-ledger interval '
+        '(category = preemption_downtime | recovery_relaunch), '
+        'bracketed by the jobs.preemption/jobs.recovery instants — '
+        'the durable twin is a goodput_intervals row',
+    # ----- training goodput plane (obs/goodput.py) -------------------------
+    'train.phase':
+        'One trainer-side goodput-ledger interval (category = '
+        'productive | init_compile | checkpoint_save | '
+        'checkpoint_restore; per-step input-stall time rides as a '
+        '*_s attr carved out of the enclosing interval) — the '
+        'intervals tile the run\'s wall-clock exactly',
 }
 
 # Anchor monotonic stamps to the wall clock ONCE per process: events

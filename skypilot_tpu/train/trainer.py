@@ -134,7 +134,24 @@ class Trainer:
                  sample_tokens: jax.Array,
                  train_cfg: Optional[TrainConfig] = None,
                  checkpoint_dir: Optional[str] = None,
-                 rules=None) -> None:
+                 rules=None,
+                 phases=None,
+                 host: Optional[str] = None) -> None:
+        from skypilot_tpu.obs import goodput as goodput_lib
+        self._gp = goodput_lib
+        # Goodput phase recorder: classifies this process's wall-clock
+        # (a managed job exports SKYTPU_GOODPUT_JOB and gets the
+        # durable ledger; otherwise gauges + flight recorder only).
+        # Opened BEFORE state init so sharded-init + step compilation
+        # land in init_compile, not unclassified.
+        self.phases = (phases if phases is not None
+                       else goodput_lib.PhaseRecorder.from_env())
+        self.phases.begin(goodput_lib.INIT_COMPILE)
+        # Host identity for the per-host step-time histogram label
+        # (straggler skew is computed across these).
+        self.host = (host if host is not None
+                     else f'host{jax.process_index()}')
+        self._badput_exported: dict = {}
         self.model = model
         self.mesh = mesh
         self.state, self.shardings = make_train_state(
@@ -154,7 +171,9 @@ class Trainer:
         step = self._ckpt_mgr.latest_step()
         if step is None:
             return 0
+        self.phases.begin(self._gp.CHECKPOINT_RESTORE)
         self.state = self._ckpt_mgr.restore(step, self.state)
+        self.phases.begin(self._gp.INIT_COMPILE)
         return step
 
     def run(self, data: Iterator[jax.Array],  # skytpu: hot-entry
@@ -163,6 +182,8 @@ class Trainer:
             log_every: int = 10,
             log_fn: Callable[[dict], None] = None) -> dict:
         from skypilot_tpu.server import metrics as metrics_lib
+        gp = self._gp
+        phases = self.phases
         metrics = {}
         t0 = time.perf_counter()
         tokens_seen = 0
@@ -173,8 +194,19 @@ class Trainer:
         # compile time into the denominator forever.
         window_tokens = 0
         window_start = t0
+        if phases.category != gp.INIT_COMPILE:
+            phases.begin(gp.INIT_COMPILE, t0)
+        # Non-productive seconds of THIS run (compile window, checkpoint
+        # saves, input stalls): subtracted from every throughput
+        # denominator, so a checkpoint-heavy run's tokens/s measures
+        # training speed, not orbax speed.
+        nonprod_s = 0.0
+        window_nonprod = 0.0
+        window_stall = 0.0
         for i in range(num_steps):
+            fetch_t = time.perf_counter()
             batch = next(data)
+            stall = time.perf_counter() - fetch_t
             tokens_seen += batch.size
             window_tokens += batch.size
             self.state, metrics = self.train_step(self.state, batch)
@@ -183,45 +215,93 @@ class Trainer:
             # steady state — and no sync is added here.
             now = time.perf_counter()
             if i > 0:
+                window_stall += stall
                 metrics_lib.observe_hist('skytpu_train_step_seconds',
-                                         now - prev)
+                                         now - prev, host=self.host)
             else:
                 # Step 0 is dominated by XLA trace+compile; one such
                 # sample would inflate the histogram sum (and the first
                 # throughput window) for the whole run.
                 window_tokens = 0
                 window_start = now
+                nonprod_s += now - t0
+                phases.begin(gp.PRODUCTIVE, now)
             if checkpoint_every and (i + 1) % checkpoint_every == 0:
+                ck0 = time.perf_counter()
+                phases.begin(gp.CHECKPOINT_SAVE, ck0)
                 self.save_checkpoint()
+                ck1 = time.perf_counter()
+                phases.begin(gp.PRODUCTIVE, ck1)
+                nonprod_s += ck1 - ck0
+                window_nonprod += ck1 - ck0
             if (i + 1) % log_every == 0:
                 # Gauges export on every boundary, log_fn or not — a
                 # run launched without a log callback must still be
                 # scrapeable mid-flight.  (Donated buffers bound how
                 # far dispatch runs ahead, so the wall-clock window is
                 # honest without forcing a sync here.)
+                phases.carve(gp.INPUT_STALL, window_stall)
+                nonprod_s += window_stall
+                window_nonprod += window_stall
+                elapsed = time.perf_counter() - window_start
                 self._export_throughput(
-                    window_tokens / (time.perf_counter() - window_start),
+                    window_tokens / max(elapsed - window_nonprod, 1e-9),
                     batch)
+                self._export_goodput()
                 if log_fn:
                     # skytpu: allow-sync(log-boundary read only, and the fetch is of an ALREADY-retired step's metrics — dispatch stays ahead)
                     m = jax.device_get(metrics)
-                    m['tokens_per_s'] = tokens_seen / (
-                        time.perf_counter() - t0)
+                    m['tokens_per_s'] = tokens_seen / max(
+                        time.perf_counter() - t0 - nonprod_s, 1e-9)
                     log_fn(m)
                 window_tokens = 0
+                window_stall = 0.0
+                window_nonprod = 0.0
                 window_start = time.perf_counter()
             # Re-stamp AFTER checkpoint/log work: a multi-second orbax
             # save attributed to the next step would spike the step-time
             # p99 every checkpoint interval.
             prev = time.perf_counter()
+        phases.carve(gp.INPUT_STALL, window_stall)
+        nonprod_s += window_stall
+        window_nonprod += window_stall
+        end = time.perf_counter()
+        # Roll (flush) the open interval at run end: a job preempted a
+        # second from now keeps this run's productive seconds in the
+        # durable ledger.
+        if phases.category is not None:
+            phases.begin(phases.category, end)
         # skytpu: allow-sync(end of run: the final metrics fetch, after the last step)
         out = jax.device_get(metrics)
-        out['tokens_per_s'] = tokens_seen / (time.perf_counter() - t0)
+        out['tokens_per_s'] = tokens_seen / max(end - t0 - nonprod_s,
+                                                1e-9)
         if window_tokens:
             self._export_throughput(
-                window_tokens / (time.perf_counter() - window_start),
+                window_tokens / max(end - window_start - window_nonprod,
+                                    1e-9),
                 batch)
+        self._export_goodput()
         return out
+
+    def _export_goodput(self) -> None:
+        """Goodput gauge + badput counter deltas from the recorder's
+        live snapshot — scrape-visible mid-flight, like the throughput
+        gauges (no db write, no sync)."""
+        from skypilot_tpu.server import metrics as metrics_lib
+        snap = self.phases.snapshot()
+        wall = sum(snap.values())
+        if wall <= 0:
+            return
+        metrics_lib.set_gauge(
+            metrics_lib.TRAIN_GOODPUT_FAMILY,
+            100.0 * snap.get(self._gp.PRODUCTIVE, 0.0) / wall)
+        for cat in self._gp.BADPUT_CATEGORIES:
+            total = snap.get(cat, 0.0)
+            delta = total - self._badput_exported.get(cat, 0.0)
+            if delta > 0:
+                metrics_lib.inc_counter(metrics_lib.TRAIN_BADPUT_FAMILY,
+                                        delta, category=cat)
+                self._badput_exported[cat] = total
 
     def _export_throughput(self, tokens_per_s: float, batch) -> None:
         """tokens/sec + estimated-MFU gauges (bench.py's FLOP

@@ -7,6 +7,7 @@ from skypilot_tpu.obs.alerts import AlertRule
 from skypilot_tpu.server import metrics as metrics_lib
 
 ROGUE_FAMILY = 'skytpu_engine_rogue_latency_seconds'
+ROGUE_SKEW = 'skytpu_train_rogue_skew'
 
 
 def rules():
@@ -22,8 +23,22 @@ def rules():
         AlertRule(name='rogue_ratio', kind='ratio',
                   family='skytpu_lb_shed_total',
                   ratio_family='skytpu_lb_rogue_total', target=0.05),
+        # BAD: the training-rule kinds are held to the same registry —
+        # a gauge_low watching an unregistered goodput family...
+        AlertRule(name='rogue_goodput', kind='gauge_low',
+                  family='skytpu_train_rogue_goodput_percent',
+                  pool='train', target=80.0),
+        # ...and a gauge_high (ceiling) on an unregistered skew family
+        # named via a module constant.
+        AlertRule(name='rogue_straggler', kind='gauge_high',
+                  family=ROGUE_SKEW, pool='train', target=1.3),
         # OK: registered families resolved through every supported
         # form (metrics_lib attribute and literal).
         AlertRule(name='fine', kind='latency_burn',
                   family=metrics_lib.ENGINE_TPOT_FAMILY, target=25.0),
+        # OK: the real train families ARE registered.
+        AlertRule(name='fine_goodput', kind='gauge_low',
+                  family=metrics_lib.TRAIN_GOODPUT_FAMILY, target=80.0),
+        AlertRule(name='fine_straggler', kind='gauge_high',
+                  family=metrics_lib.TRAIN_STEP_SKEW_FAMILY, target=1.3),
     )

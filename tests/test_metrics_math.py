@@ -327,7 +327,7 @@ def test_downsampler_histogram_deltas_conserve_without_resets():
         cinf += fast + slow
         out = d.observe(metrics_math.parse_samples(text(c01, cinf)),
                         float(tick))
-        total += out['hist'].get((fam, '', '+Inf'), 0.0)
+        total += out['hist'].get((fam, '', '', '+Inf'), 0.0)
     assert total == pytest.approx(cinf - 3.0)
 
 
@@ -345,8 +345,10 @@ def test_downsampler_pool_attribution_and_gauges():
     d.observe(metrics_math.parse_samples(text(10, 20)), 0.0, roles)
     out = d.observe(metrics_math.parse_samples(text(13, 24)), 10.0,
                     roles)
-    assert out['hist'] == {(fam, 'prefill', '+Inf'): 3.0,
-                           (fam, 'decode', '+Inf'): 4.0}
+    # The hist key carries the HISTOGRAM_SUB_FAMILIES sub-label slot
+    # ('' for families without one, e.g. this engine family).
+    assert out['hist'] == {(fam, 'prefill', '', '+Inf'): 3.0,
+                           (fam, 'decode', '', '+Inf'): 4.0}
     # Gauges pass through (latest value, replica-scoped), pool-tagged.
     assert out['gauges'] == {
         ('skytpu_engine_kv_free_pages', 'decode', '1'): 77.0}

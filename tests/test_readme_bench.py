@@ -340,3 +340,67 @@ def test_readme_fleet_claims_pinned():
 def slo_fleet_provision_delay():
     from skypilot_tpu.serve import slo_sim
     return slo_sim.FLEET_PROVISION_DELAY_S
+
+
+def test_readme_goodput_claims_pinned():
+    """The training-goodput claims are mechanical, both directions:
+    once an artifact carries detail.train.goodput, the README must
+    quote the measured sim headline VERBATIM ("lands at X% goodput
+    (Y s downtime, skew Z on hostN)"), the instrumentation price
+    ("U µs/step (V% of step time)") and the ledger-vs-wall agreement
+    ("within 1% (W% measured)"), and the artifact itself must meet the
+    acceptance bars (agreement < 1%, overhead < 1%, both train alerts
+    fired, ledger intervals within 1 s of the flight-recorder events);
+    before an artifact carries it, the README may not invent the
+    numbers."""
+    path, parsed = _latest_bench()
+    goodput = (parsed['detail'].get('train') or {}).get('goodput')
+    sim = (goodput or {}).get('sim')
+    with open(os.path.join(_ROOT, 'README.md'), encoding='utf-8') as f:
+        readme = ' '.join(f.read().split())
+    found_sim = re.findall(
+        r'lands at ([0-9.]+)% goodput \(([0-9.]+) s downtime, '
+        r'skew ([0-9.]+) on (host[0-9]+)\)', readme)
+    found_instr = re.findall(
+        r'measured at ([0-9.]+) µs/step \(([0-9.]+)% of step time\)',
+        readme)
+    found_agree = re.findall(
+        r'to within 1% \(([0-9.]+)% measured\)', readme)
+    if not sim:
+        assert not (found_sim or found_instr or found_agree), (
+            f'README claims training-goodput results '
+            f'({found_sim or found_instr or found_agree}) but the '
+            f'latest bench artifact {path} carries no '
+            f'detail.train.goodput')
+        return
+    # The acceptance criteria, held mechanically on the artifact:
+    assert goodput['ledger_vs_wall_pct'] < 1.0, (
+        f'{path}: trainer-run ledger disagrees with wall clock by '
+        f'{goodput["ledger_vs_wall_pct"]}% (>= 1%)')
+    assert sim['ledger_vs_wall_pct'] < 1.0, (
+        f'{path}: sim ledger disagrees with wall clock by '
+        f'{sim["ledger_vs_wall_pct"]}% (>= 1%)')
+    assert goodput['overhead_pct'] < 1.0, (
+        f'{path}: phase-stamping overhead {goodput["overhead_pct"]}% '
+        f'is not under 1% of step time')
+    assert abs(goodput['preemption_event_delta_s']) <= 1.0, (
+        f'{path}: ledger preemption intervals drift '
+        f'{goodput["preemption_event_delta_s"]}s from the '
+        f'flight-recorder events (> 1 s)')
+    assert {'goodput_low', 'straggler'} <= set(sim['active_alerts']), (
+        f'{path}: the planted-straggler sim did not fire both train '
+        f'alerts (got {sim["active_alerts"]})')
+    want_sim = (f"{sim['goodput_pct']:.2f}", f"{sim['downtime_s']:.1f}",
+                f"{sim['skew']:.1f}", sim['slow_host'])
+    assert found_sim and all(f == want_sim for f in found_sim), (
+        f'README sim-goodput claim {found_sim} drifted from {path}: '
+        f'expected {want_sim}')
+    want_instr = (f"{goodput['instr_us_per_step']:.1f}",
+                  f"{goodput['overhead_pct']:.2f}")
+    assert found_instr and all(f == want_instr for f in found_instr), (
+        f'README instrumentation claim {found_instr} drifted from '
+        f'{path}: expected {want_instr}')
+    want_agree = f"{goodput['ledger_vs_wall_pct']:.3f}"
+    assert found_agree and all(f == want_agree for f in found_agree), (
+        f'README ledger-agreement claim {found_agree} drifted from '
+        f'{path}: expected {want_agree}')

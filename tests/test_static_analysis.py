@@ -179,16 +179,20 @@ def test_fixture_findings_land_where_expected():
     assert 'skytpu_fleetsim_tick_millis' in fleet_msgs
     assert 'skytpu_fleetsim_rogue_total' in fleet_msgs
     # Obs fixture: AlertRule family references are held to the same
-    # registry — unregistered literal, module-constant, and
-    # ratio_family denominator are each caught; the rule built from a
-    # registered metrics_lib constant is clean.
+    # registry — unregistered literal, module-constant, ratio_family
+    # denominator, and the PR 20 train-rule kinds (gauge_low goodput
+    # floor, gauge_high skew ceiling) are each caught; the rules built
+    # from registered metrics_lib constants (including the train
+    # goodput/skew families) are clean.
     obs_hits = [f for f in by_rule['metric-naming']
                 if f.path == 'obs/bad_alert_rule.py']
-    assert len(obs_hits) == 3
+    assert len(obs_hits) == 5
     obs_msgs = ' '.join(f.message for f in obs_hits)
     assert 'skytpu_obs_rogue_seconds' in obs_msgs
     assert 'skytpu_engine_rogue_latency_seconds' in obs_msgs
     assert 'skytpu_lb_rogue_total' in obs_msgs
+    assert 'skytpu_train_rogue_goodput_percent' in obs_msgs
+    assert 'skytpu_train_rogue_skew' in obs_msgs
     assert all('can never fire' in f.message for f in obs_hits)
     # speculation: the jit-inside-propose/verify hazard AND the
     # unpinned verify program — both from the speculation fixture,
